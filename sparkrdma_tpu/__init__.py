@@ -1,0 +1,29 @@
+"""sparkrdma_tpu — a TPU-native distributed shuffle framework.
+
+Re-designs the capabilities of SparkRDMA (meisongzhu/SparkRDMA, a fork of
+Mellanox/SparkRDMA v3.1: an ibverbs/DiSNI one-sided-RDMA shuffle transport
+plugin for Apache Spark) as an idiomatic jax/XLA/Pallas framework:
+
+- SparkRDMA's RDMA READ block fetch over 100Gb RoCE/IB   ->  fixed-shape
+  ``all_to_all`` / ``ppermute`` exchanges over a TPU pod's ICI fabric,
+  compiled under ``shard_map``/``jit``.
+- ``RdmaBufferManager``'s pre-registered, size-classed NIC buffer pools  ->
+  preallocated, size-classed HBM slot pools of donated jax arrays.
+- ``RdmaNode``/``RdmaChannel`` rdma_cm connection setup  ->  a static
+  ``jax.sharding.Mesh`` (plus ``jax.distributed`` bootstrap for multi-host).
+- ``RdmaMapTaskOutput`` / ``RdmaBlockLocation`` metadata tables fetched by
+  one-sided READ  ->  a tiny size-matrix ``all_to_all`` (the "size exchange")
+  preceding every data exchange round.
+- Spark's ShuffleManager SPI (``registerShuffle/getWriter/getReader``)  ->
+  the same three-method API in :mod:`sparkrdma_tpu.api`.
+
+See SURVEY.md at the repo root for the full structural analysis of the
+reference and the layer-by-layer mapping.
+"""
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.runtime.mesh import MeshRuntime
+
+__version__ = "0.1.0"
+
+__all__ = ["ShuffleConf", "MeshRuntime", "__version__"]

@@ -1,0 +1,6 @@
+"""ShuffleManager-shaped public API.
+
+Mirrors the Spark shuffle SPI surface the reference plugs into
+(``registerShuffle`` / ``getWriter`` / ``getReader`` / ``unregisterShuffle``
+/ ``stop``). See :mod:`sparkrdma_tpu.api.shuffle_manager`.
+"""

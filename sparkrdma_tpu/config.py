@@ -1,0 +1,131 @@
+"""Typed configuration for the shuffle framework.
+
+TPU-native equivalent of SparkRDMA's ``RdmaShuffleConf``
+(src/main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleConf.scala), which
+exposes typed accessors over ``spark.shuffle.rdma.*`` keys. The knobs that
+survive the move to TPU keep their reference meaning:
+
+===============================  ==============================================
+reference key                    here
+===============================  ==============================================
+``maxAggBlock`` (~2MB)           ``slot_records`` — capacity of one exchange
+                                 slot per (src, dst) pair per round. The
+                                 reference aggregates adjacent blocks into one
+                                 RDMA READ up to this size; we size the padded
+                                 all_to_all slot the same way.
+bytes-in-flight throttle         ``max_rounds_in_flight`` — how many exchange
+                                 rounds may be dispatched before blocking.
+``preAllocateBuffers``           ``prealloc`` — "records:count,..." spec for
+ ("size:count,...")              warm slot-pool classes.
+``recvQueueDepth`` /             ``queue_depth`` — reader result-queue bound
+``sendQueueDepth``               (completed slots awaiting consumption).
+``collectShuffleReadStats``      ``collect_shuffle_read_stats``
+``maxConnectionAttempts``        ``max_retry_attempts`` — job-level retries
+                                 from persisted map outputs.
+``useOdp``                       dropped (no MR registration on TPU); the
+                                 moral analogue ``spill_to_host`` gates the
+                                 host staging pool.
+``cpuList``                      dropped (no CQ polling threads to pin).
+===============================  ==============================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+#: Number of 32-bit words a record occupies in exchange buffers by default:
+#: 2 key words (lexicographic uint64 as hi/lo) + 2 payload words.
+DEFAULT_KEY_WORDS = 2
+DEFAULT_VAL_WORDS = 2
+
+
+def _parse_prealloc(spec: str) -> Dict[int, int]:
+    """Parse a ``"records:count,records:count"`` prealloc spec.
+
+    Mirrors RdmaShuffleConf's parsing of ``spark.shuffle.rdma
+    .preAllocateBuffers`` ("size:count,...") used by RdmaBufferManager's
+    startup preallocation loop.
+    """
+    out: Dict[int, int] = {}
+    spec = spec.strip()
+    if not spec:
+        return out
+    for item in spec.split(","):
+        size_s, _, count_s = item.partition(":")
+        size, count = int(size_s), int(count_s)
+        if size <= 0 or count <= 0:
+            raise ValueError(f"invalid prealloc entry {item!r}")
+        out[size] = out.get(size, 0) + count
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleConf:
+    """All knobs for a shuffle job. Frozen so it can be a static jit arg."""
+
+    # --- exchange geometry (maxAggBlock / bytes-in-flight analogues) ---
+    slot_records: int = 4096          # records per (src,dst) slot per round
+    max_rounds: int = 64              # static upper bound on streaming rounds
+    max_rounds_in_flight: int = 2     # double-buffering depth
+    queue_depth: int = 8              # completed-slot queue bound (recvQueueDepth)
+
+    # --- record geometry ---
+    key_words: int = DEFAULT_KEY_WORDS   # uint32 words per key
+    val_words: int = DEFAULT_VAL_WORDS   # uint32 words per payload
+
+    # --- slot pool (RdmaBufferManager analogues) ---
+    prealloc: str = ""                # "records:count,..." warm classes
+    max_slot_records: int = 1 << 22   # refuse larger single allocations
+
+    # --- observability ---
+    collect_shuffle_read_stats: bool = False
+
+    # --- fault handling ---
+    max_retry_attempts: int = 3       # maxConnectionAttempts analogue
+    fault_injection_rate: float = 0.0  # probability of injected exchange fault
+
+    # --- host staging / spill ---
+    spill_to_host: bool = False
+    use_native_staging: bool = True   # C++ staging pool when available
+
+    def __post_init__(self) -> None:
+        if self.slot_records <= 0:
+            raise ValueError("slot_records must be positive")
+        if self.key_words <= 0 or self.val_words < 0:
+            raise ValueError("key_words must be >=1, val_words >=0")
+        if self.max_rounds <= 0 or self.max_rounds_in_flight <= 0:
+            raise ValueError("round counts must be positive")
+        _parse_prealloc(self.prealloc)  # validate eagerly
+
+    @property
+    def record_words(self) -> int:
+        """Total uint32 words per record in exchange buffers."""
+        return self.key_words + self.val_words
+
+    @property
+    def slot_bytes(self) -> int:
+        """Bytes of one (src,dst) slot — comparable to maxAggBlock."""
+        return self.slot_records * self.record_words * 4
+
+    def prealloc_classes(self) -> Dict[int, int]:
+        return _parse_prealloc(self.prealloc)
+
+    def replace(self, **kw) -> "ShuffleConf":
+        return dataclasses.replace(self, **kw)
+
+
+def size_class(n_records: int) -> int:
+    """Round a record count up to its power-of-two size class.
+
+    Same bucketing rule as RdmaBufferManager (src/main/java/org/apache/spark/
+    shuffle/rdma/RdmaBufferManager.java §get): requests are served from
+    power-of-two-classed free stacks so buffers are reusable across requests
+    of similar size (and, here, so XLA sees few distinct shapes to compile).
+    """
+    if n_records <= 0:
+        raise ValueError("n_records must be positive")
+    return 1 << (n_records - 1).bit_length()
+
+
+__all__ = ["ShuffleConf", "size_class", "DEFAULT_KEY_WORDS", "DEFAULT_VAL_WORDS"]

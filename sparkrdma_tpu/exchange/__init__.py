@@ -1,0 +1,6 @@
+"""The transport layer: slotted collective exchange over the mesh.
+
+Replaces SparkRDMA's L2 data plane (RdmaChannel's one-sided RDMA READ work
+queues) with fixed-shape ``all_to_all`` / ``ppermute`` rounds compiled under
+``shard_map``. See :mod:`sparkrdma_tpu.exchange.protocol`.
+"""

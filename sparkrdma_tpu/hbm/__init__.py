@@ -1,0 +1,12 @@
+"""HBM slot pools — the registered-buffer layer.
+
+Replaces SparkRDMA's ``RdmaBufferManager`` / ``RdmaBuffer`` /
+``RdmaRegisteredBuffer`` stack (pre-registered, size-classed, ref-counted NIC
+buffers) with preallocated, size-classed pools of jax device arrays whose
+fixed shapes keep XLA compile caches warm and whose buffers are donated into
+exchange steps.
+"""
+
+from sparkrdma_tpu.hbm.slot_pool import Slot, SlotPool
+
+__all__ = ["Slot", "SlotPool"]

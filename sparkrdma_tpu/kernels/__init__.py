@@ -1,0 +1,22 @@
+"""On-chip compute kernels: partition bucketing, slot packing, sort/merge.
+
+These replace the reference's CPU-side data path — Spark's ExternalSorter on
+the map side and the decompress/deserialize/merge pipeline on the reduce
+side — with jnp/XLA ops (Pallas variants in :mod:`sparkrdma_tpu.kernels
+.pallas` for the hot paths), so shuffled bytes never leave HBM.
+"""
+
+from sparkrdma_tpu.kernels.bucketing import bucket_records, fill_round_slots
+from sparkrdma_tpu.kernels.sort import (
+    compact,
+    lexsort_records,
+    merge_sorted_runs,
+)
+
+__all__ = [
+    "bucket_records",
+    "fill_round_slots",
+    "compact",
+    "lexsort_records",
+    "merge_sorted_runs",
+]

@@ -1,0 +1,93 @@
+"""Map-side partition bucketing and slot packing.
+
+This is the map half of the data path. In the reference, map output is
+produced by stock Spark (``SortShuffleWriter`` -> ``ExternalSorter``: sort
+records by reduce-partition id into one data file + an index file of
+per-partition offsets), and ``RdmaMappedFile`` then exposes each partition
+as an ``(addr, len)`` range for one-sided READ (src/main/java/org/apache/
+spark/shuffle/rdma/RdmaMappedFile.java §getRdmaBlockLocation).
+
+Here the same two steps happen in HBM:
+
+- :func:`bucket_records` = the ExternalSorter: a stable sort of the local
+  records by destination partition, yielding the "data file" (sorted record
+  array) and the "index file" (per-partition counts/offsets) in one pass.
+- :func:`fill_round_slots` = RdmaMappedFile + the fetcher's block
+  aggregation: carve the bucketed records into fixed-capacity per-destination
+  slots for exchange round ``r``. Fixed capacity is what turns SparkRDMA's
+  exact-byte-range READs into XLA-legal static shapes; partitions larger
+  than one slot stream across multiple rounds (the ``maxAggBlock`` /
+  chunked-READ analogue, SURVEY.md §5 long-context row).
+
+All functions are jit-safe per-device functions (no collectives) operating
+on ``records: uint32[N, W]`` with ``part_ids: int32[N]``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_records(
+    records: jax.Array, part_ids: jax.Array, num_parts: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Stable-sort local records by destination partition.
+
+    Returns ``(sorted_records, sorted_part_ids, counts, offsets)`` where
+    ``counts[p]`` is the number of local records bound for partition ``p``
+    and ``offsets[p]`` is the start of partition ``p``'s run in
+    ``sorted_records`` — the exact content of Spark's shuffle index file.
+    """
+    n = records.shape[0]
+    part_ids = part_ids.astype(jnp.int32)
+    order = jnp.argsort(part_ids, stable=True)
+    sorted_records = jnp.take(records, order, axis=0)
+    sorted_pids = jnp.take(part_ids, order)
+    counts = jnp.bincount(part_ids, length=num_parts).astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    del n
+    return sorted_records, sorted_pids, counts, offsets
+
+
+def fill_round_slots(
+    sorted_records: jax.Array,
+    sorted_pids: jax.Array,
+    counts: jax.Array,
+    offsets: jax.Array,
+    num_parts: int,
+    capacity: int,
+    round_idx,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pack round ``round_idx``'s window of each bucket into send slots.
+
+    Slot ``p`` receives records ``[r*capacity, (r+1)*capacity)`` of bucket
+    ``p`` (record-rank window, like a chunked RDMA READ at byte offset
+    ``r*maxAggBlock``). Returns ``(slots: uint32[num_parts, capacity, W],
+    send_counts: int32[num_parts])``; slot tails beyond ``send_counts[p]``
+    are zero-filled padding.
+    """
+    n, w = sorted_records.shape
+    round_idx = jnp.asarray(round_idx, jnp.int32)
+    # rank of each record within its destination bucket
+    pos_in_bucket = jnp.arange(n, dtype=jnp.int32) - jnp.take(offsets, sorted_pids)
+    rel = pos_in_bucket - round_idx * capacity
+    valid = (rel >= 0) & (rel < capacity)
+    # flat scatter destination; invalid records land in a dump row
+    flat_dest = jnp.where(valid, sorted_pids * capacity + rel,
+                          num_parts * capacity)
+    slots = (
+        jnp.zeros((num_parts * capacity + 1, w), dtype=sorted_records.dtype)
+        .at[flat_dest]
+        .set(sorted_records, mode="drop")[: num_parts * capacity]
+        .reshape(num_parts, capacity, w)
+    )
+    send_counts = jnp.clip(counts - round_idx * capacity, 0, capacity)
+    return slots, send_counts.astype(jnp.int32)
+
+
+__all__ = ["bucket_records", "fill_round_slots"]

@@ -1,0 +1,108 @@
+"""Reduce-side kernels: compaction, wide-key sort, run merge.
+
+In the reference the reduce side hands fetched blocks to stock Spark:
+decompress -> deserialize -> optional ``Aggregator`` combine -> optional
+``ExternalSorter`` key-ordering spill-sort (RdmaShuffleReader §read). Here
+the same post-fetch stages run in HBM on fixed-shape arrays:
+
+- :func:`compact` squeezes the valid prefix out of padded exchange slots
+  (the analogue of consuming completed fetch buffers off the result queue);
+- :func:`lexsort_records` is the ExternalSorter analogue: sort records by a
+  multi-word (e.g. 64-bit as hi/lo uint32) key;
+- :func:`merge_sorted_runs` exploits that each source's run arrives already
+  key-sorted (when the writer pre-sorts), like Spark's tiered merge.
+
+Keys sort lexicographically over their uint32 words, most-significant word
+first — matching TeraSort's byte-lexicographic ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def compact(
+    records: jax.Array, valid: jax.Array, out_capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Pack valid records to the front; return ``(packed, count)``.
+
+    ``records: [N, W]``, ``valid: bool[N]``. Output has static shape
+    ``[out_capacity, W]`` (zero-padded). A stable argsort on the inverted
+    mask is XLA's native way to partition without dynamic shapes.
+
+    ``count`` is the TRUE number of valid records, which may exceed
+    ``out_capacity``; callers must treat ``count > out_capacity`` as
+    overflow (records beyond capacity are not in ``packed``) and size
+    capacity accordingly — the analogue of a fetch buffer too small for the
+    block, which the reference also surfaces to the caller rather than
+    resizing silently.
+    """
+    n = records.shape[0]
+    order = jnp.argsort(~valid, stable=True)
+    packed = jnp.take(records, order, axis=0)
+    if out_capacity <= n:
+        packed = packed[:out_capacity]
+    else:
+        packed = jnp.pad(packed, ((0, out_capacity - n), (0, 0)))
+    count = jnp.sum(valid).astype(jnp.int32)
+    live = jnp.minimum(count, out_capacity)
+    packed = packed * (jnp.arange(out_capacity) < live)[:, None].astype(
+        packed.dtype
+    )
+    return packed, count
+
+
+def _composite_sort_order(keys: jax.Array, valid=None) -> jax.Array:
+    """Stable order sorting rows of ``keys: uint32[N, K]`` lexicographically.
+
+    Least-significant-word stable sorts first (LSD), most-significant last —
+    each pass being stable makes the composite order lexicographic. Invalid
+    rows (padding) sort to the end.
+    """
+    n, k = keys.shape
+    order = jnp.arange(n, dtype=jnp.int32)
+    for word in range(k - 1, -1, -1):
+        order = jnp.take(order, jnp.argsort(jnp.take(keys[:, word], order),
+                                            stable=True))
+    if valid is not None:
+        order = jnp.take(order, jnp.argsort(~jnp.take(valid, order),
+                                            stable=True))
+    return order
+
+
+def lexsort_records(
+    records: jax.Array, key_words: int, valid: jax.Array | None = None
+) -> jax.Array:
+    """Sort ``records: uint32[N, W]`` by their leading ``key_words`` words.
+
+    Padding rows (``valid == False``) are moved to the tail regardless of
+    key value. Stable within equal keys.
+    """
+    order = _composite_sort_order(records[:, :key_words], valid)
+    return jnp.take(records, order, axis=0)
+
+
+def merge_sorted_runs(
+    runs: jax.Array, run_counts: jax.Array, key_words: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge ``S`` key-sorted runs into one sorted stream.
+
+    ``runs: uint32[S, C, W]`` (each run sorted on its valid prefix),
+    ``run_counts: int32[S]``. Returns ``(merged: [S*C, W], total: int32)``
+    with padding at the tail. XLA has no efficient k-way merge primitive, so
+    this flattens and re-sorts — O(n log n) but fully parallel on the VPU;
+    a Pallas true-merge is the planned upgrade (SURVEY.md §7 step 8).
+    """
+    s, c, w = runs.shape
+    flat = runs.reshape(s * c, w)
+    valid = (jnp.arange(c)[None, :] < run_counts[:, None]).reshape(s * c)
+    merged = lexsort_records(flat, key_words, valid)
+    total = jnp.sum(run_counts).astype(jnp.int32)
+    merged = merged * (jnp.arange(s * c) < total)[:, None].astype(merged.dtype)
+    return merged, total
+
+
+__all__ = ["compact", "lexsort_records", "merge_sorted_runs"]

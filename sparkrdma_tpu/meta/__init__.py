@@ -1,0 +1,6 @@
+"""Map-output metadata: the RdmaMapTaskOutput / RdmaBlockLocation layer.
+
+Per-shuffle size tables exchanged one-sided (a tiny counts all_to_all over
+ICI) plus a host-side registry of shuffle participants (the hello/announce
+RPC analogue). See :mod:`sparkrdma_tpu.meta.map_output`.
+"""

@@ -1,0 +1,12 @@
+"""Mesh bootstrap and topology — the connection layer.
+
+Replaces SparkRDMA's L0–L2 connection machinery (libibverbs QPs, librdmacm
+connect/accept, RdmaNode's listener + channel cache) with a static
+``jax.sharding.Mesh``: on TPU the fabric links are brought up by the runtime,
+so "connection establishment" reduces to constructing the mesh once.
+"""
+
+from sparkrdma_tpu.runtime.mesh import MeshRuntime, make_mesh
+from sparkrdma_tpu.runtime.distributed import initialize_distributed
+
+__all__ = ["MeshRuntime", "make_mesh", "initialize_distributed"]

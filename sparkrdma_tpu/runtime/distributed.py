@@ -1,0 +1,81 @@
+"""Multi-host bootstrap — the ``librdmacm`` / connection-manager analogue.
+
+SparkRDMA establishes peer connectivity lazily: RdmaNode binds an rdma_cm
+listener at startup and ``getRdmaChannel`` resolves/dials peers on first
+fetch (RdmaChannel §connect: rdma_resolve_addr -> rdma_resolve_route ->
+create RC QP -> rdma_connect, with retry). On TPU the fabric is static, so
+the whole connection layer collapses to one call to
+``jax.distributed.initialize`` that joins this process to the coordinator
+and makes every chip in the pod visible in ``jax.devices()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("sparkrdma_tpu.runtime")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    max_attempts: int = 3,
+    retry_delay_s: float = 2.0,
+) -> bool:
+    """Join the jax distributed runtime, with connect retries.
+
+    Retry-on-connect mirrors RdmaNode/RdmaChannel's ``maxConnectionAttempts``
+    loop — the one piece of connection-manager behavior worth keeping.
+
+    Returns True if distributed mode is active after the call. A single
+    process (no coordinator configured anywhere) is not an error: the
+    framework degrades to single-process multi-device, exactly like running
+    SparkRDMA with a one-executor cluster.
+    """
+    # Probe initialization state without touching jax.process_count(): that
+    # would initialize the local backend and make a later
+    # jax.distributed.initialize() impossible.
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax
+        from jax._src import distributed as _dist
+
+        already = _dist.global_state.client is not None
+    if already:
+        return True  # already initialized by the launcher
+    env_coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and env_coord is None:
+        log.info("no coordinator configured; single-process mode")
+        return False
+
+    last_err: Optional[Exception] = None
+    for attempt in range(1, max_attempts + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            log.info(
+                "joined distributed runtime: process %d/%d",
+                jax.process_index(),
+                jax.process_count(),
+            )
+            return True
+        except Exception as e:  # pragma: no cover - needs real cluster
+            last_err = e
+            log.warning("distributed init attempt %d/%d failed: %s",
+                        attempt, max_attempts, e)
+            time.sleep(retry_delay_s)
+    raise RuntimeError(
+        f"could not join distributed runtime after {max_attempts} attempts"
+    ) from last_err
+
+
+__all__ = ["initialize_distributed"]

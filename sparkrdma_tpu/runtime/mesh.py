@@ -1,0 +1,142 @@
+"""Per-process mesh runtime — the ``RdmaNode`` analogue.
+
+SparkRDMA keeps exactly one ``RdmaNode`` per JVM (src/main/java/org/apache/
+spark/shuffle/rdma/RdmaNode.java §ctor): it opens one verbs context, binds an
+rdma_cm listener, owns the registered-buffer pool, and hands out cached
+``RdmaChannel`` connections to peers. On TPU none of that exists as user
+code — the ICI links are static and brought up by the runtime — so the
+equivalent object owns:
+
+- the ``jax.sharding.Mesh`` over the shuffle axis (one shuffle partition per
+  device, the BASELINE north star), replacing the per-peer QP/channel cache;
+- the :class:`~sparkrdma_tpu.hbm.slot_pool.SlotPool`, replacing
+  ``RdmaBufferManager``;
+- process/topology introspection, replacing ``RdmaShuffleManagerId``'s
+  (host, port) identity.
+
+There is deliberately no connect/accept path: where ``RdmaNode.getRdmaChannel``
+dials and caches a connection (§getRdmaChannel, with maxConnectionAttempts
+retries), ``MeshRuntime`` just validates that the peer is a mesh coordinate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.config import ShuffleConf
+
+#: Canonical name of the shuffle mesh axis. Every collective in
+#: :mod:`sparkrdma_tpu.exchange` runs over this axis.
+SHUFFLE_AXIS = "shuffle"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = SHUFFLE_AXIS,
+) -> Mesh:
+    """Build a 1-D shuffle mesh over ``devices`` (default: all local devices).
+
+    The 1-D shape matches the reference's flat peer set: SparkRDMA addresses
+    every executor by (host, port) with no topology hierarchy. Multi-host and
+    multi-slice topologies still present as one flat axis here; slice-aware
+    hierarchical exchange is layered above (exchange/hierarchical).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devs = np.asarray(devices, dtype=object)
+    return Mesh(devs, (axis_name,))
+
+
+@dataclasses.dataclass(frozen=True)
+class ManagerId:
+    """Identity of one shuffle participant — ``RdmaShuffleManagerId`` analogue.
+
+    The reference identifies a peer by (host, port, BlockManagerId)
+    (src/main/java/org/apache/spark/shuffle/rdma/RdmaShuffleManagerId.java);
+    on a mesh, identity is (process_index, mesh coordinate).
+    """
+
+    process_index: int
+    device_index: int
+
+    def __str__(self) -> str:  # matches the reference's host:port logging style
+        return f"proc{self.process_index}/dev{self.device_index}"
+
+
+class MeshRuntime:
+    """One per process; owns mesh + pool, like one RdmaNode per JVM."""
+
+    def __init__(
+        self,
+        conf: Optional[ShuffleConf] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        axis_name: str = SHUFFLE_AXIS,
+    ):
+        self.conf = conf or ShuffleConf()
+        self.mesh = make_mesh(devices, axis_name)
+        self.axis_name = axis_name
+        # Import here to avoid a cycle (hbm imports config only).
+        from sparkrdma_tpu.hbm.slot_pool import SlotPool
+
+        # RdmaNode ctor preallocates+registers the buffer pool up front; same.
+        self.pool = SlotPool(self.conf)
+
+    # ------------------------------------------------------------------
+    # topology introspection
+    # ------------------------------------------------------------------
+    @property
+    def num_partitions(self) -> int:
+        """World size of the shuffle axis = number of shuffle partitions."""
+        return int(self.mesh.shape[self.axis_name])
+
+    @property
+    def devices(self) -> Tuple[jax.Device, ...]:
+        return tuple(self.mesh.devices.flat)
+
+    def manager_id(self, device_index: int) -> ManagerId:
+        d = self.devices[device_index]
+        return ManagerId(process_index=d.process_index, device_index=device_index)
+
+    def local_device_indices(self) -> Tuple[int, ...]:
+        """Mesh coordinates owned by this process (multi-host case)."""
+        me = jax.process_index()
+        return tuple(
+            i for i, d in enumerate(self.devices) if d.process_index == me
+        )
+
+    # ------------------------------------------------------------------
+    # sharding helpers
+    # ------------------------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding over the shuffle axis; default shards leading dim."""
+        if not spec:
+            spec = (self.axis_name,)
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_rows(self, x) -> jax.Array:
+        """Place host data with rows split across the shuffle axis."""
+        return jax.device_put(x, self.sharding())
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        """Free pooled slots — RdmaNode.stop (drain + dereg pools) analogue."""
+        self.pool.clear()
+
+    def __enter__(self) -> "MeshRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+__all__ = ["MeshRuntime", "ManagerId", "make_mesh", "SHUFFLE_AXIS"]

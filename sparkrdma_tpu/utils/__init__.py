@@ -1,0 +1,2 @@
+"""Observability: structured logging, counters, and per-peer fetch
+histograms (the RdmaShuffleReaderStats analogue)."""

@@ -1,0 +1,3 @@
+"""Shuffle-bound workloads matching the reference's validation set
+(SURVEY.md §6): repartition microbench, TeraSort, TPC-DS-style joins, ALS,
+PageRank."""

@@ -1,0 +1,54 @@
+"""Test harness: force an 8-device CPU mesh.
+
+This is the "fake backend" SparkRDMA never had (SURVEY.md §4): real
+``all_to_all`` semantics on any machine via XLA's forced host platform,
+standing in for an 8-chip ICI mesh.
+
+Platform forcing is subtle in this deployment: a sitecustomize module may
+import jax and register the real-TPU PJRT plugin at interpreter startup
+(and hangs at startup if ``JAX_PLATFORMS=cpu`` is in the *environment*), so
+we cannot rely on env vars alone. Instead: append the forced-host-device
+flag to ``XLA_FLAGS`` before the first backend initialization, then select
+the CPU platform through ``jax.config`` — both still effective after
+``import jax`` as long as no backend has been initialized yet.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+if "jax" not in sys.modules:
+    # Clean interpreter (no sitecustomize): safe to select via env too.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def runtime():
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+
+    rt = MeshRuntime(ShuffleConf(slot_records=256))
+    yield rt
+    rt.stop()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,43 @@
+import pytest
+
+from sparkrdma_tpu.config import ShuffleConf, size_class, _parse_prealloc
+
+
+def test_defaults_valid():
+    conf = ShuffleConf()
+    assert conf.record_words == conf.key_words + conf.val_words
+    assert conf.slot_bytes == conf.slot_records * conf.record_words * 4
+
+
+def test_size_class_power_of_two():
+    assert size_class(1) == 1
+    assert size_class(2) == 2
+    assert size_class(3) == 4
+    assert size_class(4096) == 4096
+    assert size_class(4097) == 8192
+    with pytest.raises(ValueError):
+        size_class(0)
+
+
+def test_prealloc_parse():
+    assert _parse_prealloc("") == {}
+    assert _parse_prealloc("1024:4,65536:2") == {1024: 4, 65536: 2}
+    assert _parse_prealloc("1024:1,1024:2") == {1024: 3}
+    with pytest.raises(ValueError):
+        _parse_prealloc("0:4")
+    with pytest.raises(ValueError):
+        ShuffleConf(prealloc="-1:2")
+
+
+def test_invalid_conf_rejected():
+    with pytest.raises(ValueError):
+        ShuffleConf(slot_records=0)
+    with pytest.raises(ValueError):
+        ShuffleConf(key_words=0)
+    with pytest.raises(ValueError):
+        ShuffleConf(max_rounds=0)
+
+
+def test_replace():
+    conf = ShuffleConf().replace(slot_records=128)
+    assert conf.slot_records == 128

@@ -1,0 +1,90 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.kernels import compact, lexsort_records, merge_sorted_runs
+
+
+def make_records(rng, n, key_words=2, val_words=2):
+    return jnp.asarray(
+        rng.integers(0, 2**32, size=(n, key_words + val_words), dtype=np.uint32)
+    )
+
+
+def np_lexsort_rows(arr, key_words):
+    # numpy reference: lexicographic over leading key words, msw first
+    keys = tuple(arr[:, w] for w in range(key_words - 1, -1, -1))
+    return arr[np.lexsort(keys)]
+
+
+def test_compact_packs_valid_prefix(rng):
+    recs = make_records(rng, 16)
+    valid = jnp.asarray(rng.random(16) < 0.5)
+    packed, count = compact(recs, valid, 16)
+    assert int(count) == int(valid.sum())
+    np.testing.assert_array_equal(
+        np.asarray(packed[: int(count)]), np.asarray(recs)[np.asarray(valid)]
+    )
+    assert not np.any(np.asarray(packed[int(count):]))
+
+
+def test_compact_overflow_reports_true_count(rng):
+    recs = make_records(rng, 8)
+    valid = jnp.ones(8, bool)
+    packed, count = compact(recs, valid, 4)
+    assert int(count) == 8  # caller must detect count > capacity
+    assert packed.shape == (4, 4)
+    np.testing.assert_array_equal(np.asarray(packed), np.asarray(recs)[:4])
+
+
+def test_compact_capacity_larger_than_input(rng):
+    recs = make_records(rng, 4)
+    valid = jnp.asarray([True, False, True, False])
+    packed, count = compact(recs, valid, 10)
+    assert packed.shape == (10, 4)
+    assert int(count) == 2
+    assert not np.any(np.asarray(packed[2:]))
+
+
+def test_lexsort_matches_numpy(rng):
+    recs = make_records(rng, 100)
+    out = np.asarray(lexsort_records(recs, 2))
+    np.testing.assert_array_equal(out, np_lexsort_rows(np.asarray(recs), 2))
+
+
+def test_lexsort_single_word_keys(rng):
+    recs = make_records(rng, 50, key_words=1, val_words=1)
+    out = np.asarray(lexsort_records(recs, 1))
+    ref = np.asarray(recs)[np.argsort(np.asarray(recs)[:, 0], kind="stable")]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_lexsort_moves_invalid_to_tail(rng):
+    recs = make_records(rng, 20)
+    valid = jnp.asarray(rng.random(20) < 0.7)
+    out = np.asarray(lexsort_records(recs, 2, valid))
+    nvalid = int(valid.sum())
+    ref_valid = np_lexsort_rows(np.asarray(recs)[np.asarray(valid)], 2)
+    np.testing.assert_array_equal(out[:nvalid], ref_valid)
+
+
+def test_merge_sorted_runs(rng):
+    s, c = 4, 8
+    runs, counts = [], []
+    all_valid = []
+    for _ in range(s):
+        n = int(rng.integers(0, c + 1))
+        rec = np.asarray(make_records(rng, c)).copy()
+        rec[:n] = np_lexsort_rows(rec[:n], 2)
+        rec[n:] = 0
+        runs.append(rec)
+        counts.append(n)
+        all_valid.append(rec[:n])
+    merged, total = merge_sorted_runs(
+        jnp.asarray(np.stack(runs)), jnp.asarray(np.array(counts, np.int32)), 2
+    )
+    assert int(total) == sum(counts)
+    ref = np_lexsort_rows(np.concatenate(all_valid), 2) if sum(counts) else None
+    if ref is not None:
+        np.testing.assert_array_equal(np.asarray(merged[: int(total)]), ref)
+    assert not np.any(np.asarray(merged[int(total):]))

@@ -1,0 +1,42 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.runtime.mesh import SHUFFLE_AXIS, make_mesh
+
+
+def test_mesh_covers_all_devices(runtime, devices):
+    assert runtime.num_partitions == 8
+    assert set(runtime.devices) == set(devices)
+    assert runtime.mesh.axis_names == (SHUFFLE_AXIS,)
+
+
+def test_manager_ids_unique(runtime):
+    ids = [runtime.manager_id(i) for i in range(runtime.num_partitions)]
+    assert len(set(ids)) == runtime.num_partitions
+    assert str(ids[0]).startswith("proc")
+
+
+def test_local_device_indices_single_process(runtime):
+    assert runtime.local_device_indices() == tuple(range(8))
+
+
+def test_shard_rows_places_one_row_group_per_device(runtime):
+    x = np.arange(8 * 4, dtype=np.uint32).reshape(8, 4)
+    arr = runtime.shard_rows(x)
+    assert arr.sharding.is_equivalent_to(runtime.sharding(), ndim=2)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # each device holds exactly one row
+    assert sorted(s.data.shape for s in arr.addressable_shards) == [(1, 4)] * 8
+
+
+def test_make_mesh_subset(devices):
+    mesh = make_mesh(devices[:4])
+    assert mesh.shape[SHUFFLE_AXIS] == 4
+
+
+def test_runtime_context_manager():
+    with MeshRuntime(ShuffleConf(prealloc="64:2")) as rt:
+        assert rt.pool.preallocated == 2
+    assert rt.pool.free_counts() == {}

@@ -1,0 +1,81 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.hbm.slot_pool import SlotPool
+
+
+def make_pool(**kw):
+    return SlotPool(ShuffleConf(**kw))
+
+
+def test_get_rounds_to_size_class():
+    pool = make_pool()
+    slot = pool.get(1000)
+    assert slot.capacity == 1024
+    assert slot.array.shape == (1024, pool.conf.record_words)
+    assert slot.array.dtype == jnp.uint32
+
+
+def test_put_get_reuses_buffer():
+    pool = make_pool()
+    slot = pool.get(512)
+    arr_id = id(slot.array)
+    slot.release()
+    slot2 = pool.get(512)
+    assert id(slot2.array) == arr_id
+    assert pool.hits == 1 and pool.misses == 1
+
+
+def test_distinct_classes_not_shared():
+    pool = make_pool()
+    a = pool.get(100)   # class 128
+    a.release()
+    b = pool.get(300)   # class 512 -> miss
+    assert b.capacity == 512
+    assert pool.misses == 2
+
+
+def test_refcount_retain_release():
+    pool = make_pool()
+    slot = pool.get(64)
+    slot.retain()
+    slot.release()
+    assert pool.free_counts() == {}  # still held
+    slot.release()
+    assert sum(pool.free_counts().values()) == 1
+    with pytest.raises(RuntimeError):
+        slot.release()
+
+
+def test_view_slicing_and_bounds():
+    pool = make_pool()
+    slot = pool.get(64)
+    v = slot.view(8, 16)
+    assert v.shape == (16, pool.conf.record_words)
+    with pytest.raises(ValueError):
+        slot.view(60, 10)
+
+
+def test_prealloc_warms_classes():
+    pool = make_pool(prealloc="256:3")
+    assert pool.preallocated == 3
+    s = pool.get(200)
+    assert pool.hits == 1 and pool.misses == 0
+    s.release()
+
+
+def test_max_slot_records_enforced():
+    pool = make_pool(max_slot_records=1024)
+    with pytest.raises(ValueError):
+        pool.get(2048)
+
+
+def test_record_words_override():
+    pool = make_pool()
+    slot = pool.get(64, record_words=8)
+    assert slot.array.shape == (64, 8)
+    slot.release()
+    assert pool.get(64, record_words=8).array.shape == (64, 8)
+    assert pool.hits == 1
