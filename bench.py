@@ -1,0 +1,60 @@
+"""Headline benchmark: TeraSort shuffle throughput per chip.
+
+Runs the full shuffle pipeline (range-partition -> slotted all_to_all
+exchange -> per-chip lexicographic sort) over all visible devices and
+reports shuffled GB/s per chip. Baseline is the reference's transport
+ceiling: SparkRDMA rides a 100Gb/s RoCE/IB NIC, i.e. 12.5 GB/s per node
+(BASELINE.md); on one TPU chip the exchange degenerates to the on-chip
+pipeline, which is exactly the part the NIC could never help with.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: BENCH_RECORDS_PER_DEVICE (default 16M ~= 256MB/chip),
+BENCH_PAYLOAD_WORDS (default 2).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
+                                            16 * 1024 * 1024))
+    import jax
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    mesh_size = len(jax.devices())
+    # slot capacity sized so a balanced shuffle fits in ~1 round with
+    # headroom for 2x skew
+    slot = max(4096, (2 * records_per_device) // max(1, mesh_size))
+    conf = ShuffleConf(slot_records=slot,
+                       max_rounds=64,
+                       collect_shuffle_read_stats=False)
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    try:
+        res, _, _ = run_terasort(
+            manager,
+            records_per_device=records_per_device,
+            verify=False,   # full host-side permutation check is O(n log n)
+                            # on host; correctness is covered by tests/
+            warmup=True,
+            shuffle_id=0,
+        )
+        gbps_per_chip = res.gbps / mesh_size
+        baseline_gbps = 12.5  # 100Gb/s RoCE per node, BASELINE.md
+        print(json.dumps({
+            "metric": "terasort_shuffle_gbps_per_chip",
+            "value": round(gbps_per_chip, 3),
+            "unit": "GB/s/chip",
+            "vs_baseline": round(gbps_per_chip / baseline_gbps, 3),
+        }))
+    finally:
+        manager.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

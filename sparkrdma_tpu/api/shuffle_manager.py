@@ -1,0 +1,262 @@
+"""ShuffleManager-shaped public API — the Spark SPI surface, TPU-native.
+
+The reference integrates with Spark through five SPI methods
+(src/main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleManager.scala:
+``registerShuffle``, ``getWriter``, ``getReader``, ``unregisterShuffle``,
+``stop``); this module exposes the same five so a user of the reference
+finds the same workflow:
+
+    manager = ShuffleManager(runtime)
+    handle  = manager.register_shuffle(0, num_parts=8, partitioner=part)
+    manager.get_writer(handle).write(records)         # map stage
+    out, totals = manager.get_reader(handle).read()   # reduce stage
+    manager.unregister_shuffle(0); manager.stop()
+
+Differences forced (and earned) by SPMD:
+
+- One writer/reader pair drives ALL partitions at once (a compiled SPMD
+  program), not one per task. ``get_reader``'s partition-range arguments
+  become a partition *filter* applied after exchange.
+- ``RdmaWrapperShuffleWriter`` delegates the actual write to stock Spark
+  and then mmaps+registers the files (§write/§stop); here ``write()``
+  keeps the records resident in HBM (they never need to leave) and
+  publishes the size table to the registry — publication *is* the
+  ``RdmaMapTaskOutput`` fill.
+- ``RdmaShuffleReader.read`` wraps the fetch in deserialization, optional
+  aggregation, and optional key-ordering sort; ``read()`` here mirrors
+  that: exchange, then optional key-ordering (lexsort) — aggregation
+  composes the same way via kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
+from sparkrdma_tpu.kernels.sort import lexsort_records
+from sparkrdma_tpu.meta.map_output import MapOutputRegistry
+from sparkrdma_tpu.runtime.mesh import MeshRuntime
+from sparkrdma_tpu.utils.stats import ExchangeRecord, ShuffleReadStats, Timer
+
+log = logging.getLogger("sparkrdma_tpu.api")
+
+
+@dataclasses.dataclass
+class ShuffleHandle:
+    """Opaque ticket returned by register_shuffle (Spark's ShuffleHandle)."""
+
+    shuffle_id: int
+    num_parts: int
+    partitioner: Callable
+
+
+class ShuffleWriter:
+    """Map-side: publish records for exchange (RdmaWrapperShuffleWriter).
+
+    ``write`` accepts the global sharded record array; ``stop(success)``
+    mirrors the reference's contract where the mmap/register/publish work
+    happens in §stop, not §write.
+    """
+
+    def __init__(self, manager: "ShuffleManager", handle: ShuffleHandle):
+        self._m = manager
+        self._h = handle
+        self._records: Optional[jax.Array] = None
+        self._plan: Optional[ShufflePlan] = None
+
+    def write(self, records: jax.Array) -> "ShuffleWriter":
+        if self._records is not None:
+            raise RuntimeError("writer already holds records (one write per "
+                               "map stage, like one SortShuffleWriter.write)")
+        self._records = records
+        return self
+
+    def stop(self, success: bool = True) -> Optional[ShufflePlan]:
+        """On success: plan (size-exchange) + publish metadata."""
+        if not success or self._records is None:
+            self._records = None
+            return None
+        with Timer() as t:
+            self._plan = self._m._exchange.plan(
+                self._records, self._h.partitioner, self._h.num_parts
+            )
+        self._m._registry.publish_map_output(self._h.shuffle_id,
+                                             self._plan.counts)
+        self._m._plan_seconds[self._h.shuffle_id] = t.elapsed
+        log.debug("shuffle %d map published: %d records, %d rounds",
+                  self._h.shuffle_id, self._plan.total_records,
+                  self._plan.num_rounds)
+        return self._plan
+
+    # internal accessors for the reader
+    @property
+    def records(self) -> Optional[jax.Array]:
+        return self._records
+
+    @property
+    def plan(self) -> Optional[ShufflePlan]:
+        return self._plan
+
+
+class ShuffleReader:
+    """Reduce-side: run the exchange, optionally key-sort (RdmaShuffleReader)."""
+
+    def __init__(self, manager: "ShuffleManager", handle: ShuffleHandle,
+                 start_partition: int = 0,
+                 end_partition: Optional[int] = None,
+                 key_ordering: bool = False):
+        self._m = manager
+        self._h = handle
+        self.start_partition = start_partition
+        self.end_partition = (handle.num_parts if end_partition is None
+                              else end_partition)
+        self.key_ordering = key_ordering
+
+    def read(self) -> Tuple[jax.Array, jax.Array]:
+        """Execute the planned exchange; return ``(records, totals)``.
+
+        ``records``: ``uint32[mesh * out_capacity, W]`` sharded over the
+        mesh, each device's rows = its received partitions, grouped by
+        (local partition, source), zero-padded to ``totals`` per device.
+        With ``key_ordering`` each device's prefix is lexsorted (the
+        ExternalSorter stage of RdmaShuffleReader.read).
+        """
+        writer = self._m._writers.get(self._h.shuffle_id)
+        if writer is None or writer.records is None or writer.plan is None:
+            raise RuntimeError(
+                f"shuffle {self._h.shuffle_id}: no published map output; "
+                "call get_writer(handle).write(records).stop() first"
+            )
+        ex = self._m._exchange
+        with Timer() as t:
+            out, totals, incoming = ex.exchange(
+                writer.records, self._h.partitioner, writer.plan,
+                self._h.num_parts
+            )
+            if self.key_ordering:
+                out = self._m._sorted(out, totals, writer.plan)
+            out = jax.block_until_ready(out)
+        plan = writer.plan
+        mesh = self._m.runtime.num_partitions
+        # per-source totals for the histogram: sum counts over partitions
+        per_source = plan.counts.sum(axis=1)
+        self._m.stats.add(ExchangeRecord(
+            shuffle_id=self._h.shuffle_id,
+            plan_s=self._m._plan_seconds.get(self._h.shuffle_id, 0.0),
+            exec_s=t.elapsed,
+            total_records=plan.total_records,
+            record_bytes=out.shape[-1] * 4,
+            num_rounds=plan.num_rounds,
+            per_source_records=per_source,
+        ))
+        del mesh, incoming
+        return out, totals
+
+    def read_partition(self, partition: int) -> np.ndarray:
+        """Materialize one partition's records on host (debug/small data).
+
+        The SPMD exchange produces all partitions; this is the per-task
+        view Spark's reader iterator would have returned.
+        """
+        out, totals = self.read()
+        mesh = self._m.runtime.num_partitions
+        d, q = partition % mesh, partition // mesh
+        plan = self._m._writers[self._h.shuffle_id].plan
+        dev_rows = np.asarray(out).reshape(mesh, plan.out_capacity, -1)[d]
+        ppd = self._h.num_parts // mesh
+        # partition q starts after local partitions 0..q-1 of device d
+        owned = plan.counts.sum(axis=0)
+        start = sum(int(owned[qq * mesh + d]) for qq in range(q))
+        length = int(owned[partition])
+        return dev_rows[start:start + length]
+
+
+class ShuffleManager:
+    """The SPI root object — one per process, like RdmaShuffleManager."""
+
+    def __init__(self, runtime: Optional[MeshRuntime] = None,
+                 conf: Optional[ShuffleConf] = None):
+        self.runtime = runtime or MeshRuntime(conf)
+        self.conf = conf or self.runtime.conf
+        self._exchange = ShuffleExchange(self.runtime.mesh,
+                                         self.runtime.axis_name, self.conf)
+        ids = tuple(self.runtime.manager_id(i)
+                    for i in range(self.runtime.num_partitions))
+        self._registry = MapOutputRegistry(ids)
+        self._writers: dict[int, ShuffleWriter] = {}
+        self._plan_seconds: dict[int, float] = {}
+        self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats)
+        self._sort_cache: dict[tuple, Callable] = {}
+
+    # --- SPI ----------------------------------------------------------
+    def register_shuffle(self, shuffle_id: int, num_parts: int,
+                         partitioner: Callable) -> ShuffleHandle:
+        self._registry.register(shuffle_id, num_parts, partitioner)
+        return ShuffleHandle(shuffle_id, num_parts, partitioner)
+
+    def get_writer(self, handle: ShuffleHandle) -> ShuffleWriter:
+        w = ShuffleWriter(self, handle)
+        self._writers[handle.shuffle_id] = w
+        return w
+
+    def get_reader(self, handle: ShuffleHandle, start_partition: int = 0,
+                   end_partition: Optional[int] = None,
+                   key_ordering: bool = False) -> ShuffleReader:
+        return ShuffleReader(self, handle, start_partition, end_partition,
+                             key_ordering)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self._registry.unregister(shuffle_id)
+        self._writers.pop(shuffle_id, None)
+        self._plan_seconds.pop(shuffle_id, None)
+
+    def stop(self) -> None:
+        if self.stats.enabled and self.stats.records:
+            self.stats.print_histogram()
+        self._writers.clear()
+        self.runtime.stop()
+
+    # --- helpers ------------------------------------------------------
+    def _sorted(self, out: jax.Array, totals: jax.Array,
+                plan: ShufflePlan) -> jax.Array:
+        """Per-device lexsort of the valid prefix, compiled per geometry."""
+        key_words = self.conf.key_words
+        cap = plan.out_capacity
+        w = out.shape[-1]
+        key = (cap, w, key_words)
+        fn = self._sort_cache.get(key)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                shard_map = jax.shard_map
+            except AttributeError:  # pragma: no cover
+                from jax.experimental.shard_map import shard_map
+
+            def local_sort(rows, total):
+                valid = jnp.arange(cap) < total[0]
+                return lexsort_records(rows, key_words, valid)
+
+            fn = jax.jit(shard_map(
+                local_sort, mesh=self.runtime.mesh,
+                in_specs=(P(self.runtime.axis_name), P(self.runtime.axis_name)),
+                out_specs=P(self.runtime.axis_name),
+            ))
+            self._sort_cache[key] = fn
+        return fn(out, totals)
+
+    def __enter__(self) -> "ShuffleManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+__all__ = ["ShuffleManager", "ShuffleHandle", "ShuffleWriter", "ShuffleReader"]

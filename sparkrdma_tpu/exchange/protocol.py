@@ -1,0 +1,294 @@
+"""The slotted all-to-all exchange — the data plane.
+
+This module is the TPU-native re-design of SparkRDMA's entire fetch path
+(SURVEY.md §3.3): where ``RdmaShuffleFetcherIterator`` groups needed blocks
+per remote executor, RDMA-READs each executor's ``RdmaMapTaskOutput`` table,
+aggregates adjacent blocks up to ``maxAggBlock``, throttles bytes in flight,
+and posts one-sided READs into pooled registered buffers
+(src/main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleFetcherIterator
+.scala §fetchBlocks / §next), here the same job is one compiled SPMD
+program:
+
+1. **Size exchange** — a [P]-vector ``all_to_all`` of per-destination record
+   counts. This *is* the metadata fetch: one-sided, no driver hot spot,
+   ~16B x P per chip (the reference reads RdmaMapTaskOutput tables by RDMA
+   READ for the same reason — SURVEY.md §2.3 design point).
+2. **Data rounds** — ``num_rounds`` fixed-shape ``all_to_all``s of
+   ``[P, capacity, W]`` slot tensors. Fixed capacity is the XLA-legal form
+   of block aggregation (``maxAggBlock``); partitions bigger than one slot
+   stream across rounds exactly like the reference's chunked READs through
+   bounded buffers. Rounds are unrolled in one traced program so XLA can
+   overlap round r+1's packing with round r's collective — the analogue of
+   the fetcher overlapping fetch with consumption.
+3. **Compaction** — received slots are squeezed into one dense local
+   partition (the result-queue drain + stream concat).
+
+The number of rounds is data-dependent, so a shuffle is *planned* first
+(:func:`plan_shuffle` — one tiny compiled step + host reduction) and then
+*executed* with static geometry (:meth:`ShuffleExchange.exchange`). This
+two-phase structure is the reference's own: fetch metadata, then size and
+issue the reads.
+
+Partitions-per-device: ``num_parts`` must equal the mesh axis size times an
+integer ``parts_per_device``; partition ``p`` lives on device ``p %
+mesh_size`` (round-robin, like Spark's reduce-task placement across
+executors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkrdma_tpu.config import ShuffleConf, size_class
+from sparkrdma_tpu.kernels.bucketing import bucket_records, fill_round_slots
+from sparkrdma_tpu.kernels.sort import compact
+
+try:  # jax >= 0.7 promotes shard_map to the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+@dataclasses.dataclass(frozen=True)
+class ShufflePlan:
+    """Host-side execution plan — what the metadata fetch tells the reducer.
+
+    ``counts[s, p]`` = records device ``s`` will send to partition ``p``
+    (the global RdmaMapTaskOutput table). ``num_rounds`` and
+    ``out_capacity`` are the static geometry derived from it.
+    """
+
+    counts: np.ndarray          # int64 [mesh, num_parts]
+    num_rounds: int
+    out_capacity: int           # per-device compacted output capacity
+    capacity: int               # slot capacity used for planning
+
+    @property
+    def total_records(self) -> int:
+        return int(self.counts.sum())
+
+
+def _device_partition_counts(counts_local, num_parts, mesh_size, axis_name):
+    """[num_parts] per-dest counts -> [mesh, parts_per_device] for a2a.
+
+    Partition p is owned by device p % mesh_size; column-group g of the
+    result holds the partitions owned by device g.
+    """
+    ppd = num_parts // mesh_size
+    # reorder columns so owner-device blocks are contiguous: dest device d
+    # owns partitions d, d+mesh, d+2*mesh, ...
+    idx = jnp.arange(num_parts).reshape(ppd, mesh_size).T.reshape(-1)
+    return jnp.take(counts_local, idx, axis=0).reshape(mesh_size, ppd)
+
+
+def _make_count_fn(mesh: Mesh, axis_name: str, num_parts: int,
+                   partitioner: Callable) -> Callable:
+    """Build the planning step: global records -> global counts matrix."""
+
+    def local_counts(records):
+        pids = partitioner(records).astype(jnp.int32)
+        counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
+        return counts[None, :]
+
+    return jax.jit(
+        shard_map(
+            local_counts,
+            mesh=mesh,
+            in_specs=(P(axis_name),),
+            out_specs=P(axis_name),
+        )
+    )
+
+
+class ShuffleExchange:
+    """Compiled-exchange factory + cache — the ``RdmaChannel`` cache analogue.
+
+    One instance per :class:`~sparkrdma_tpu.runtime.mesh.MeshRuntime`.
+    Where ``RdmaNode.getRdmaChannel`` caches one connection per peer, this
+    caches one *compiled program* per exchange geometry
+    ``(num_parts, capacity, rounds, out_capacity, record_words)`` — the
+    thing that is expensive to set up and reusable across shuffles on TPU.
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str,
+                 conf: Optional[ShuffleConf] = None):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.conf = conf or ShuffleConf()
+        self.mesh_size = int(mesh.shape[axis_name])
+        self._exec_cache: Dict[Tuple, Callable] = {}
+        self._count_cache: Dict[Tuple, Callable] = {}
+
+    # ------------------------------------------------------------------
+    # phase 1: plan (the metadata fetch)
+    # ------------------------------------------------------------------
+    def plan(
+        self,
+        records: jax.Array,
+        partitioner: Callable,
+        num_parts: Optional[int] = None,
+        capacity: Optional[int] = None,
+    ) -> ShufflePlan:
+        """Compute the global counts matrix and derive static geometry.
+
+        One compiled step (bincount + implicit all-gather of the [mesh,
+        num_parts] matrix to host) followed by two host reductions. The
+        host round-trip is tiny and is exactly the reference's "read the
+        map-output table before issuing READs" step.
+        """
+        num_parts = num_parts or self.mesh_size
+        capacity = capacity or self.conf.slot_records
+        if num_parts % self.mesh_size:
+            raise ValueError(
+                f"num_parts {num_parts} not a multiple of mesh size "
+                f"{self.mesh_size}"
+            )
+        key = (num_parts, getattr(partitioner, "cache_key", id(partitioner)))
+        fn = self._count_cache.get(key)
+        if fn is None:
+            fn = _make_count_fn(self.mesh, self.axis_name, num_parts,
+                                partitioner)
+            self._count_cache[key] = fn
+        counts = np.asarray(jax.device_get(fn(records))).astype(np.int64)
+        per_pair_max = int(counts.max(initial=0))
+        num_rounds = max(1, math.ceil(per_pair_max / capacity))
+        if num_rounds > self.conf.max_rounds:
+            raise ValueError(
+                f"partition skew needs {num_rounds} rounds > max_rounds "
+                f"{self.conf.max_rounds}; raise slot_records or max_rounds"
+            )
+        # records received by device d = sum over sources of counts[:, p]
+        # for the partitions p owned by d (p % mesh == d)
+        owned = counts.sum(axis=0)  # [num_parts]
+        per_device_in = np.array(
+            [owned[d::self.mesh_size].sum() for d in range(self.mesh_size)]
+        )
+        out_capacity = size_class(max(1, int(per_device_in.max())))
+        return ShufflePlan(
+            counts=counts,
+            num_rounds=num_rounds,
+            out_capacity=out_capacity,
+            capacity=capacity,
+        )
+
+    # ------------------------------------------------------------------
+    # phase 2: execute (the data plane)
+    # ------------------------------------------------------------------
+    def _build_exec(self, num_parts: int, capacity: int, num_rounds: int,
+                    out_capacity: int, record_words: int,
+                    partitioner: Callable) -> Callable:
+        mesh_size = self.mesh_size
+        ppd = num_parts // mesh_size
+        ax = self.axis_name
+
+        def local_step(records):
+            # --- map side: bucket into per-partition runs -------------
+            pids = partitioner(records).astype(jnp.int32)
+            sr, sp, counts, offs = bucket_records(records, pids, num_parts)
+
+            # --- size exchange (metadata fetch analogue) --------------
+            dev_counts = _device_partition_counts(
+                counts, num_parts, mesh_size, ax)          # [mesh, ppd]
+            incoming = lax.all_to_all(
+                dev_counts, ax, split_axis=0, concat_axis=0, tiled=True
+            )                                               # [mesh, ppd]
+
+            # --- data rounds ------------------------------------------
+            recv_rounds = []
+            for r in range(num_rounds):
+                slots, _ = fill_round_slots(
+                    sr, sp, counts, offs, num_parts, capacity, r
+                )                                           # [P, C, W]
+                # group per destination device: [mesh, ppd, C, W]
+                slots = slots.reshape(ppd, mesh_size, capacity, record_words
+                                      ).transpose(1, 0, 2, 3)
+                recv = lax.all_to_all(
+                    slots, ax, split_axis=0, concat_axis=0, tiled=True
+                )                                           # [mesh, ppd, C, W]
+                recv_rounds.append(recv)
+
+            # --- reduce side: concat rounds, compact ------------------
+            # data[s, q, r, c] = round r's c-th record from source s for
+            # local partition q. Group the output stream by local partition
+            # first, then source (a reduce task consumes ITS partition from
+            # every map output in map order), then rounds*capacity.
+            data = jnp.stack(recv_rounds, axis=2)   # [mesh, ppd, rounds, C, W]
+            stream = data.transpose(1, 0, 2, 3, 4).reshape(
+                ppd * mesh_size, num_rounds * capacity, record_words
+            )
+            valid = (
+                jnp.arange(num_rounds * capacity)[None, :]
+                < incoming.T.reshape(-1)[:, None]
+            )
+            out, total = compact(
+                stream.reshape(-1, record_words), valid.reshape(-1),
+                out_capacity,
+            )
+            return out, total[None], incoming[None]
+
+        return jax.jit(
+            shard_map(
+                local_step,
+                mesh=self.mesh,
+                in_specs=(P(ax),),
+                out_specs=(P(ax), P(ax), P(ax)),
+            )
+        )
+
+    def exchange(
+        self,
+        records: jax.Array,
+        partitioner: Callable,
+        plan: ShufflePlan,
+        num_parts: Optional[int] = None,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Run the planned exchange.
+
+        Args:
+          records: global ``uint32[mesh*N_local, W]`` sharded over the
+            shuffle axis (rows grouped by source device).
+          partitioner: jit-safe ``records -> int32[n]`` destination
+            partition ids; must match the one used in :meth:`plan`.
+          plan: output of :meth:`plan`.
+
+        Returns ``(out, totals, incoming)``:
+          - ``out``: ``uint32[mesh*out_capacity, W]`` — device d's rows are
+            its compacted received records (zero-padded tail);
+          - ``totals``: ``int32[mesh]`` — valid record count per device;
+          - ``incoming``: ``int32[mesh, mesh*ppd... ]`` flattened per-source
+            counts table (observability; the received metadata).
+        """
+        num_parts = num_parts or self.mesh_size
+        w = records.shape[-1]
+        key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
+               w, getattr(partitioner, "cache_key", id(partitioner)))
+        fn = self._exec_cache.get(key)
+        if fn is None:
+            fn = self._build_exec(num_parts, plan.capacity, plan.num_rounds,
+                                  plan.out_capacity, w, partitioner)
+            self._exec_cache[key] = fn
+        return fn(records)
+
+    def shuffle(
+        self,
+        records: jax.Array,
+        partitioner: Callable,
+        num_parts: Optional[int] = None,
+        capacity: Optional[int] = None,
+    ) -> Tuple[jax.Array, jax.Array, ShufflePlan]:
+        """plan + exchange in one call. Returns ``(out, totals, plan)``."""
+        plan = self.plan(records, partitioner, num_parts, capacity)
+        out, totals, _ = self.exchange(records, partitioner, plan, num_parts)
+        return out, totals, plan
+
+
+__all__ = ["ShuffleExchange", "ShufflePlan"]

@@ -1,0 +1,101 @@
+"""Shuffle metadata registry — the control plane.
+
+Maps SparkRDMA's L3 onto host-side Python + on-fabric size exchange:
+
+- ``RdmaShuffleManagerHelloRpcMsg`` / ``RdmaAnnounceRdmaShuffleManagersRpcMsg``
+  (executor announces itself to the driver; driver broadcasts the manager
+  list): on a static mesh membership is known at construction, so the
+  registry just materializes every :class:`ManagerId` up front — the
+  announce round-trip has nothing left to do, which is the point of moving
+  to a static fabric.
+- ``RdmaMapTaskOutput`` / ``RdmaBlockLocation`` (per-map-task tables of
+  (addr, len, rkey) per reduce partition, fetched one-sided by reducers):
+  the per-shuffle ``counts[source, partition]`` matrix. Addresses and rkeys
+  are meaningless on TPU — slot position in the exchange round IS the
+  address — so only lengths remain, and they are exchanged on-fabric by
+  ``ShuffleExchange.plan`` (exchange/protocol.py), not through this host
+  registry. The registry keeps the *host-visible copy* for observability,
+  spill sizing, and job-level retry.
+
+Key design point preserved from the reference (SURVEY.md §2.3): the driver
+never brokers per-block metadata — it only tracks who exists and which
+shuffles are registered. Size data moves one-sided between peers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.runtime.mesh import ManagerId
+
+
+@dataclasses.dataclass
+class ShuffleMeta:
+    """Everything the control plane knows about one registered shuffle."""
+
+    shuffle_id: int
+    num_parts: int
+    partitioner: Callable
+    registered_at: float = dataclasses.field(default_factory=time.monotonic)
+    # populated when the map stage publishes (write path)
+    counts: Optional[np.ndarray] = None      # [mesh, num_parts]
+    map_published_at: Optional[float] = None
+
+    @property
+    def total_records(self) -> Optional[int]:
+        return None if self.counts is None else int(self.counts.sum())
+
+
+class MapOutputRegistry:
+    """Host-side shuffle + membership registry (driver role, minus the RPC).
+
+    Thread-safe like the reference's ConcurrentHashMap-based manager state;
+    kept single-writer-per-shuffle by convention (SURVEY.md §5 race row).
+    """
+
+    def __init__(self, manager_ids: Tuple[ManagerId, ...]):
+        self._managers = tuple(manager_ids)
+        self._shuffles: Dict[int, ShuffleMeta] = {}
+        self._lock = threading.Lock()
+
+    # --- membership (hello/announce analogue) -------------------------
+    @property
+    def managers(self) -> Tuple[ManagerId, ...]:
+        return self._managers
+
+    # --- shuffle lifecycle (registerShuffle / unregisterShuffle) ------
+    def register(self, shuffle_id: int, num_parts: int,
+                 partitioner: Callable) -> ShuffleMeta:
+        with self._lock:
+            if shuffle_id in self._shuffles:
+                raise ValueError(f"shuffle {shuffle_id} already registered")
+            meta = ShuffleMeta(shuffle_id, num_parts, partitioner)
+            self._shuffles[shuffle_id] = meta
+            return meta
+
+    def publish_map_output(self, shuffle_id: int, counts: np.ndarray) -> None:
+        """Record the host copy of the size table after the map stage."""
+        with self._lock:
+            meta = self._shuffles[shuffle_id]
+            meta.counts = np.asarray(counts, dtype=np.int64)
+            meta.map_published_at = time.monotonic()
+
+    def get(self, shuffle_id: int) -> ShuffleMeta:
+        with self._lock:
+            return self._shuffles[shuffle_id]
+
+    def unregister(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
+
+    def shuffle_ids(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(self._shuffles)
+
+
+__all__ = ["MapOutputRegistry", "ShuffleMeta"]

@@ -1,0 +1,96 @@
+"""Shuffle read statistics — the ``RdmaShuffleReaderStats`` analogue.
+
+The reference optionally histograms fetch latency per remote executor
+(behind ``spark.shuffle.rdma.collectShuffleReadStats``) and dumps the
+histogram to the executor log; Spark's own ShuffleReadMetrics counts bytes
+and records. One compiled exchange gives different observables: per-source
+record counts (from the size exchange — the incoming metadata table),
+wall-clock per phase (plan/execute), and derived per-chip throughput. We
+keep the per-peer histogram idea with bytes in place of latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("sparkrdma_tpu.stats")
+
+
+@dataclasses.dataclass
+class ExchangeRecord:
+    """One exchange's observables."""
+
+    shuffle_id: int
+    plan_s: float
+    exec_s: float
+    total_records: int
+    record_bytes: int
+    num_rounds: int
+    per_source_records: np.ndarray   # [mesh] records received per source
+
+    @property
+    def total_bytes(self) -> int:
+        return self.total_records * self.record_bytes
+
+    @property
+    def gbps(self) -> float:
+        return self.total_bytes / max(self.exec_s, 1e-9) / 1e9
+
+
+class ShuffleReadStats:
+    """Accumulates exchange records; prints histograms like the reference."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.records: List[ExchangeRecord] = []
+
+    def add(self, rec: ExchangeRecord) -> None:
+        if self.enabled:
+            self.records.append(rec)
+
+    def per_source_histogram(self) -> Dict[int, int]:
+        """Total records fetched per source device across all exchanges."""
+        out: Dict[int, int] = {}
+        for r in self.records:
+            for s, c in enumerate(r.per_source_records):
+                out[s] = out.get(s, 0) + int(c)
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        if not self.records:
+            return {}
+        return {
+            "exchanges": len(self.records),
+            "total_records": sum(r.total_records for r in self.records),
+            "total_bytes": sum(r.total_bytes for r in self.records),
+            "mean_exec_s": float(np.mean([r.exec_s for r in self.records])),
+            "mean_gbps": float(np.mean([r.gbps for r in self.records])),
+        }
+
+    def print_histogram(self) -> str:
+        """Log + return the per-source fetch table (reference: dumped to
+        executor log by printRemoteFetchHistogram)."""
+        hist = self.per_source_histogram()
+        lines = ["shuffle fetch per-source records:"]
+        for s in sorted(hist):
+            lines.append(f"  source {s}: {hist[s]}")
+        text = "\n".join(lines)
+        log.info("%s", text)
+        return text
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0
+
+
+__all__ = ["ExchangeRecord", "ShuffleReadStats", "Timer"]

@@ -1,0 +1,165 @@
+"""Hash-join workload — the TPC-DS-style shuffle join (BASELINE.md config 3).
+
+TPC-DS q64/q95 are shuffle-bound because every join first co-partitions
+both tables by key across the cluster (Spark's ShuffledHashJoin /
+SortMergeJoin exchange). The shuffle legs here are two slotted exchanges
+with the same hash partitioner; the local leg is a sort-merge join.
+
+The joined row stream itself is variable-length (XLA-hostile), and the
+benchmark queries all end in aggregates anyway — so the local join
+produces the two standard reductions directly: match count and
+sum-of-payload-products (the inner-join aggregate), combined across the
+mesh with a ``psum``. Keys for this workload are single-word (hi word 0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+
+
+@dataclasses.dataclass
+class JoinResult:
+    rows_a: int
+    rows_b: int
+    matches: int
+    sum_products: float
+    shuffle_s: float
+    join_s: float
+    verified: Optional[bool] = None
+
+
+def _local_join(rows_a, total_a, rows_b, total_b, cap_a, cap_b):
+    """Per-device sort-merge join -> (count, sum of payload products).
+
+    Sorts both sides by the lo key word, then for each A row looks up B's
+    per-key aggregate via two searchsorteds — no pair materialization.
+    Payloads are the word right after the 2 key words, treated as uint32
+    values accumulated in float64-free fashion (float32 sums).
+    """
+    ka = rows_a[:, 1]
+    kb = rows_b[:, 1]
+    va = jnp.arange(cap_a) < total_a[0]
+    vb = jnp.arange(cap_b) < total_b[0]
+
+    # substitute a sentinel for padding keys BEFORE sorting and keep the
+    # substituted values: padding must sort to the tail and stay there,
+    # or searchsorted ranges would sweep padding rows in
+    ka = jnp.where(va, ka, jnp.uint32(0xFFFFFFFF))
+    kb = jnp.where(vb, kb, jnp.uint32(0xFFFFFFFF))
+    oa = jnp.argsort(ka, stable=True)
+    ob = jnp.argsort(kb, stable=True)
+    sa, pa = jnp.take(ka, oa), jnp.take(rows_a[:, 2], oa)
+    sb, pb = jnp.take(kb, ob), jnp.take(rows_b[:, 2], ob)
+    va_s = jnp.take(va, oa)
+    vb_s = jnp.take(vb, ob)
+
+    # B per-key prefix sums for O(log n) range aggregation
+    pb_f = pb.astype(jnp.float32) * vb_s
+    csum = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(pb_f)])
+    lo = jnp.searchsorted(sb, sa, side="left")
+    hi = jnp.searchsorted(sb, sa, side="right")
+    # clamp lookups into the valid region of B
+    nb = total_b[0]
+    lo = jnp.minimum(lo, nb)
+    hi = jnp.minimum(hi, nb)
+    cnt_per_a = (hi - lo) * va_s
+    sum_per_a = (jnp.take(csum, hi) - jnp.take(csum, lo)) * va_s
+    count = jnp.sum(cnt_per_a).astype(jnp.int32)
+    prods = jnp.sum(pa.astype(jnp.float32) * sum_per_a)
+    return count, prods
+
+
+def run_hash_join(
+    manager: ShuffleManager,
+    rows_per_device_a: int,
+    rows_per_device_b: int,
+    key_range: int = 1 << 12,
+    seed: int = 0,
+    shuffle_ids: Tuple[int, int] = (30, 31),
+    verify: bool = True,
+) -> JoinResult:
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    w = manager.conf.record_words
+    rng = np.random.default_rng(seed)
+
+    def gen(n):
+        x = np.zeros((mesh * n, w), dtype=np.uint32)
+        x[:, 1] = rng.integers(0, key_range, size=mesh * n)  # lo key word
+        x[:, 2] = rng.integers(1, 1000, size=mesh * n)       # payload
+        return x
+
+    xa, xb = gen(rows_per_device_a), gen(rows_per_device_b)
+    part = hash_partitioner(mesh, manager.conf.key_words)
+
+    t0 = time.perf_counter()
+    outs = []
+    for sid, x in zip(shuffle_ids, (xa, xb)):
+        handle = manager.register_shuffle(sid, mesh, part)
+        writer = manager.get_writer(handle).write(rt.shard_rows(x))
+        writer.stop(True)
+        out, totals = manager.get_reader(handle).read()
+        outs.append((out, totals, writer.plan.out_capacity))
+        manager.unregister_shuffle(sid)
+    jax.block_until_ready(outs[-1][0])
+    shuffle_s = time.perf_counter() - t0
+
+    (oa, ta, ca), (ob, tb, cb) = outs
+    ax = rt.axis_name
+
+    def local(rows_a, total_a, rows_b, total_b):
+        c, s = _local_join(rows_a, total_a, rows_b, total_b, ca, cb)
+        return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
+
+    joined = jax.jit(shard_map(
+        local, mesh=rt.mesh,
+        in_specs=(P(ax), P(ax), P(ax), P(ax)),
+        out_specs=(P(ax), P(ax)),
+    ))
+    t0 = time.perf_counter()
+    count, prods = joined(oa, ta, ob, tb)
+    count = int(np.asarray(count)[0])
+    prods = float(np.asarray(prods)[0])
+    join_s = time.perf_counter() - t0
+
+    verified = None
+    if verify:
+        ref_count, ref_sum = _numpy_reference_join(xa, xb)
+        verified = (count == ref_count
+                    and abs(prods - ref_sum) <= 1e-6 * max(1.0, abs(ref_sum)))
+    return JoinResult(
+        rows_a=xa.shape[0], rows_b=xb.shape[0], matches=count,
+        sum_products=prods, shuffle_s=shuffle_s, join_s=join_s,
+        verified=verified,
+    )
+
+
+def _numpy_reference_join(xa: np.ndarray, xb: np.ndarray) -> Tuple[int, float]:
+    ka, pa = xa[:, 1], xa[:, 2].astype(np.float64)
+    kb, pb = xb[:, 1], xb[:, 2].astype(np.float64)
+    sum_b: Dict[int, float] = {}
+    cnt_b: Dict[int, int] = {}
+    for k, p in zip(kb, pb):
+        sum_b[k] = sum_b.get(k, 0.0) + p
+        cnt_b[k] = cnt_b.get(k, 0) + 1
+    count = sum(cnt_b.get(k, 0) for k in ka)
+    total = sum(pa[i] * sum_b.get(ka[i], 0.0) for i in range(len(ka)))
+    return count, total
+
+
+__all__ = ["run_hash_join", "JoinResult"]

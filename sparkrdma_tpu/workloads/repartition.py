@@ -1,0 +1,93 @@
+"""Repartition microbenchmark — BASELINE.md config 1.
+
+The reference's smallest headline config is a ``repartition(256)`` shuffle
+of 1GB of random Long keys: all bytes cross the fabric once, no compute —
+a pure transport benchmark. Records here are ``uint32[N, W]`` with a
+2-word (64-bit) key and configurable payload, hashed to destinations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+
+
+@dataclasses.dataclass
+class RepartitionResult:
+    records: int
+    record_bytes: int
+    plan_s: float
+    exchange_s: float
+    verified: bool
+
+    @property
+    def total_bytes(self) -> int:
+        return self.records * self.record_bytes
+
+    @property
+    def gbps(self) -> float:
+        return self.total_bytes / max(self.exchange_s, 1e-9) / 1e9
+
+
+def generate_records(manager: ShuffleManager, records_per_device: int,
+                     seed: int = 0) -> jax.Array:
+    """Random records, sharded over the mesh (the map-stage input)."""
+    mesh = manager.runtime.num_partitions
+    w = manager.conf.record_words
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 2**32, size=(mesh * records_per_device, w),
+                     dtype=np.uint32)
+    return manager.runtime.shard_rows(x)
+
+
+def run_repartition(
+    manager: ShuffleManager,
+    records_per_device: int,
+    num_parts: Optional[int] = None,
+    seed: int = 0,
+    shuffle_id: int = 0,
+    verify: bool = True,
+    warmup: bool = True,
+) -> RepartitionResult:
+    """End-to-end: generate, register, write/publish, read, verify."""
+    num_parts = num_parts or manager.runtime.num_partitions
+    part = hash_partitioner(num_parts, manager.conf.key_words)
+    records = generate_records(manager, records_per_device, seed)
+
+    handle = manager.register_shuffle(shuffle_id, num_parts, part)
+    try:
+        writer = manager.get_writer(handle).write(records)
+        t0 = time.perf_counter()
+        plan = writer.stop(True)
+        plan_s = time.perf_counter() - t0
+
+        reader = manager.get_reader(handle)
+        if warmup:  # compile outside the timed region, like any TPU bench
+            jax.block_until_ready(reader.read()[0])
+        t0 = time.perf_counter()
+        out, totals = reader.read()
+        jax.block_until_ready(out)
+        exchange_s = time.perf_counter() - t0
+
+        verified = True
+        if verify:
+            verified = int(np.asarray(totals).sum()) == records.shape[0]
+        return RepartitionResult(
+            records=records.shape[0],
+            record_bytes=records.shape[1] * 4,
+            plan_s=plan_s,
+            exchange_s=exchange_s,
+            verified=verified,
+        )
+    finally:
+        manager.unregister_shuffle(shuffle_id)
+
+
+__all__ = ["run_repartition", "RepartitionResult", "generate_records"]

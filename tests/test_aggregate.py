@@ -1,0 +1,105 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.kernels.aggregate import combine_by_key, count_by_key
+
+
+def np_combine(recs, valid, key_words, float_payload=False):
+    recs = recs[valid]
+    keys = [tuple(r[:key_words]) for r in recs]
+    agg = {}
+    for k, r in zip(keys, recs):
+        pay = r[key_words:].view(np.float32) if float_payload else r[key_words:]
+        if k in agg:
+            agg[k] = agg[k] + pay
+        else:
+            agg[k] = pay.astype(np.float32) if float_payload else pay.copy()
+    out_keys = sorted(agg)
+    return out_keys, agg
+
+
+def test_combine_sum_uint(rng):
+    n = 64
+    recs = np.zeros((n, 4), dtype=np.uint32)
+    recs[:, 0] = 0
+    recs[:, 1] = rng.integers(0, 8, size=n)   # few distinct keys
+    recs[:, 2] = rng.integers(0, 100, size=n)
+    recs[:, 3] = 1
+    valid = rng.random(n) < 0.8
+    out, nuniq = combine_by_key(jnp.asarray(recs), jnp.asarray(valid), 2)
+    out = np.asarray(out)
+    ref_keys, ref = np_combine(recs, valid, 2)
+    assert int(nuniq) == len(ref_keys)
+    for i, k in enumerate(ref_keys):
+        assert tuple(out[i, :2]) == k
+        np.testing.assert_array_equal(out[i, 2:], ref[k])
+    assert not np.any(out[int(nuniq):])
+
+
+def test_combine_sum_float(rng):
+    n = 32
+    recs = np.zeros((n, 3), dtype=np.uint32)
+    recs[:, 1] = rng.integers(0, 4, size=n)
+    vals = rng.random(n).astype(np.float32)
+    recs[:, 2] = vals.view(np.uint32)
+    valid = np.ones(n, bool)
+    out, nuniq = combine_by_key(jnp.asarray(recs), jnp.asarray(valid), 2,
+                                float_payload=True)
+    out = np.asarray(out)
+    for i in range(int(nuniq)):
+        k = out[i, 1]
+        ref = vals[recs[:, 1] == k].sum()
+        got = out[i, 2:].view(np.float32)[0]
+        assert abs(got - ref) < 1e-4
+
+
+@pytest.mark.parametrize("op,npop", [("min", np.minimum), ("max", np.maximum)])
+def test_combine_min_max(rng, op, npop):
+    n = 40
+    recs = np.zeros((n, 3), dtype=np.uint32)
+    recs[:, 1] = rng.integers(0, 5, size=n)
+    recs[:, 2] = rng.integers(0, 1000, size=n)
+    valid = np.ones(n, bool)
+    out, nuniq = combine_by_key(jnp.asarray(recs), jnp.asarray(valid), 2, op=op)
+    out = np.asarray(out)
+    for i in range(int(nuniq)):
+        k = out[i, 1]
+        sel = recs[recs[:, 1] == k, 2]
+        ref = sel.min() if op == "min" else sel.max()
+        assert out[i, 2] == ref
+
+
+def test_combine_all_invalid():
+    recs = jnp.ones((8, 3), jnp.uint32)
+    out, nuniq = combine_by_key(recs, jnp.zeros(8, bool), 2)
+    assert int(nuniq) == 0
+    assert not np.any(np.asarray(out))
+
+
+def test_combine_all_unique(rng):
+    n = 16
+    recs = np.zeros((n, 3), dtype=np.uint32)
+    recs[:, 1] = np.arange(n)
+    recs[:, 2] = rng.integers(0, 100, size=n)
+    out, nuniq = combine_by_key(jnp.asarray(recs), jnp.ones(n, bool), 2)
+    assert int(nuniq) == n
+    np.testing.assert_array_equal(np.asarray(out), recs)
+
+
+def test_count_by_key(rng):
+    n = 50
+    recs = np.zeros((n, 4), dtype=np.uint32)
+    recs[:, 1] = rng.integers(0, 6, size=n)
+    out, nuniq = count_by_key(jnp.asarray(recs), jnp.ones(n, bool), 2)
+    out = np.asarray(out)
+    for i in range(int(nuniq)):
+        assert out[i, 2] == (recs[:, 1] == out[i, 1]).sum()
+
+
+def test_combine_jittable(rng):
+    recs = jnp.asarray(rng.integers(0, 4, size=(32, 3), dtype=np.uint32))
+    f = jax.jit(lambda r, v: combine_by_key(r, v, 2))
+    out, nuniq = f(recs, jnp.ones(32, bool))
+    assert out.shape == (32, 3)

@@ -1,0 +1,149 @@
+"""Exchange-protocol correctness on the 8-device CPU mesh.
+
+Golden test per SURVEY.md §4: the shuffled output must be, per destination
+partition, exactly the input records whose partitioner says they belong
+there (a permutation grouped by source order) — verified against a pure
+numpy reference shuffle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.exchange.partitioners import (
+    hash_partitioner,
+    modulo_partitioner,
+    range_partitioner,
+)
+from sparkrdma_tpu.exchange.protocol import ShuffleExchange
+
+
+@pytest.fixture(scope="module")
+def exchange():
+    from sparkrdma_tpu import MeshRuntime
+
+    rt = MeshRuntime(ShuffleConf(slot_records=16))
+    yield ShuffleExchange(rt.mesh, rt.axis_name, rt.conf), rt
+    rt.stop()
+
+
+def make_global_records(rng, rt, n_per_dev, w=4):
+    n = n_per_dev * rt.num_partitions
+    x = rng.integers(1, 2**32, size=(n, w), dtype=np.uint32)
+    return rt.shard_rows(x), x
+
+
+def np_reference_shuffle(x, pids, num_parts, mesh_size, n_per_dev):
+    """Expected per-device received sets, honoring (partition, source) order."""
+    out = {}
+    for d in range(mesh_size):
+        rows = []
+        for q in range(num_parts // mesh_size):
+            p = q * mesh_size + d
+            for s in range(mesh_size):
+                src_rows = x[s * n_per_dev:(s + 1) * n_per_dev]
+                src_pids = pids[s * n_per_dev:(s + 1) * n_per_dev]
+                rows.append(src_rows[src_pids == p])
+        out[d] = np.concatenate(rows) if rows else np.zeros((0, x.shape[1]))
+    return out
+
+
+def run_and_check(exchange_rt, x_global, x_np, part_fn, num_parts, rng):
+    ex, rt = exchange_rt
+    pids = np.asarray(part_fn(jnp.asarray(x_np)))
+    out, totals, plan = ex.shuffle(x_global, part_fn, num_parts=num_parts)
+    n_per_dev = x_np.shape[0] // rt.num_partitions
+    ref = np_reference_shuffle(x_np, pids, num_parts, rt.num_partitions,
+                               n_per_dev)
+    out_np = np.asarray(out).reshape(rt.num_partitions, plan.out_capacity, -1)
+    totals_np = np.asarray(totals)
+    for d in range(rt.num_partitions):
+        k = int(totals_np[d])
+        assert k == len(ref[d]), f"device {d}: {k} != {len(ref[d])}"
+        np.testing.assert_array_equal(out_np[d, :k], ref[d])
+        assert not np.any(out_np[d, k:])
+    # conservation: every record arrives exactly once
+    assert totals_np.sum() == x_np.shape[0]
+    return plan
+
+
+def test_single_round_exchange(exchange, rng):
+    _, rt = exchange
+    xg, xn = make_global_records(rng, rt, 32)
+    plan = run_and_check(exchange, xg, xn, modulo_partitioner(8), 8, rng)
+    assert plan.num_rounds == 1
+
+
+def test_multi_round_streaming(exchange, rng):
+    """Skewed partitions larger than one slot stream across rounds."""
+    _, rt = exchange
+    n_per_dev = 64  # worst case 64 records from one src to one dest > 16
+    x = rng.integers(1, 2**32, size=(n_per_dev * 8, 4), dtype=np.uint32)
+    x[:, 0] = 0  # every record on device 0..7 hashes to partition 0 % 8
+    xg = rt.shard_rows(x)
+    plan = run_and_check(exchange, xg, x, modulo_partitioner(8), 8, rng)
+    assert plan.num_rounds == int(np.ceil(64 / 16))
+
+
+def test_hash_partitioner_balance_and_correctness(exchange, rng):
+    _, rt = exchange
+    xg, xn = make_global_records(rng, rt, 64)
+    part = hash_partitioner(8)
+    run_and_check(exchange, xg, xn, part, 8, rng)
+    pids = np.asarray(part(jnp.asarray(xn)))
+    counts = np.bincount(pids, minlength=8)
+    assert counts.min() > 0.5 * counts.mean()  # rough balance on random keys
+
+
+def test_parts_per_device_gt_one(exchange, rng):
+    """num_parts = 2x mesh: two reduce partitions per chip."""
+    _, rt = exchange
+    xg, xn = make_global_records(rng, rt, 32)
+    run_and_check(exchange, xg, xn, modulo_partitioner(16), 16, rng)
+
+
+def test_range_partitioner_lexicographic(rng):
+    spl = np.array([[100, 0], [200, 5]], dtype=np.uint32)
+    part = range_partitioner(spl, key_words=2)
+    recs = jnp.asarray(np.array(
+        [[99, 9999, 0, 0],    # < [100,0]        -> 0
+         [100, 0, 0, 0],      # == splitter 0    -> 1
+         [100, 1, 0, 0],      # > [100,0]        -> 1
+         [200, 4, 0, 0],      # < [200,5]        -> 1
+         [200, 5, 0, 0],      # == splitter 1    -> 2
+         [4000000000, 0, 0, 0]], dtype=np.uint32))
+    np.testing.assert_array_equal(np.asarray(part(recs)), [0, 1, 1, 1, 2, 2])
+
+
+def test_empty_partitions_ok(exchange, rng):
+    """A partitioner that sends everything to one partition leaves the rest
+    empty — totals must still be exact (zero), no crash."""
+    _, rt = exchange
+    x = rng.integers(1, 2**32, size=(8 * 8, 4), dtype=np.uint32)
+    x[:, 0] = 5
+    xg = rt.shard_rows(x)
+    run_and_check(exchange, xg, x, modulo_partitioner(8), 8, rng)
+
+
+def test_plan_rejects_excessive_skew(exchange, rng):
+    ex, rt = exchange
+    conf = ShuffleConf(slot_records=2, max_rounds=4)
+    ex2 = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+    x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
+    x[:, 0] = 0
+    xg = rt.shard_rows(x)
+    with pytest.raises(ValueError, match="skew"):
+        ex2.plan(xg, modulo_partitioner(8))
+
+
+def test_exchange_program_cache_reused(exchange, rng):
+    ex, rt = exchange
+    xg, xn = make_global_records(rng, rt, 32)
+    part = modulo_partitioner(8)
+    ex.shuffle(xg, part)
+    n_programs = len(ex._exec_cache)
+    xg2, _ = make_global_records(rng, rt, 32)
+    ex.shuffle(xg2, part)
+    assert len(ex._exec_cache) == n_programs  # same geometry -> same program
