@@ -117,16 +117,28 @@ class ShuffleReader:
         self.start_partition = start_partition
         self.end_partition = (handle.num_parts if end_partition is None
                               else end_partition)
+        if not 0 <= self.start_partition < self.end_partition <= \
+                handle.num_parts:
+            raise ValueError(
+                f"invalid partition range [{self.start_partition}, "
+                f"{self.end_partition}) for {handle.num_parts} partitions"
+            )
         self.key_ordering = key_ordering
 
-    def read(self) -> Tuple[jax.Array, jax.Array]:
+    def read(self, record_stats: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Execute the planned exchange; return ``(records, totals)``.
 
         ``records``: ``uint32[mesh * out_capacity, W]`` sharded over the
         mesh, each device's rows = its received partitions, grouped by
         (local partition, source), zero-padded to ``totals`` per device.
-        With ``key_ordering`` each device's prefix is lexsorted (the
+        A partition range narrower than the full handle keeps only those
+        partitions' rows per device (totals shrink accordingly) — the
+        reduce-task partition-range view of Spark's getReader. With
+        ``key_ordering`` each device's kept prefix is lexsorted (the
         ExternalSorter stage of RdmaShuffleReader.read).
+
+        ``record_stats=False`` suppresses the stats record (used for
+        warmup/compile passes so throughput histograms stay honest).
         """
         writer = self._m._writers.get(self._h.shuffle_id)
         if writer is None or writer.records is None or writer.plan is None:
@@ -140,23 +152,28 @@ class ShuffleReader:
                 writer.records, self._h.partitioner, writer.plan,
                 self._h.num_parts
             )
+            if (self.start_partition, self.end_partition) != (
+                    0, self._h.num_parts):
+                out, totals = self._m._filtered(
+                    out, totals, writer.plan, self._h.num_parts,
+                    self.start_partition, self.end_partition)
             if self.key_ordering:
                 out = self._m._sorted(out, totals, writer.plan)
             out = jax.block_until_ready(out)
         plan = writer.plan
-        mesh = self._m.runtime.num_partitions
-        # per-source totals for the histogram: sum counts over partitions
-        per_source = plan.counts.sum(axis=1)
-        self._m.stats.add(ExchangeRecord(
-            shuffle_id=self._h.shuffle_id,
-            plan_s=self._m._plan_seconds.get(self._h.shuffle_id, 0.0),
-            exec_s=t.elapsed,
-            total_records=plan.total_records,
-            record_bytes=out.shape[-1] * 4,
-            num_rounds=plan.num_rounds,
-            per_source_records=per_source,
-        ))
-        del mesh, incoming
+        if record_stats:
+            # per-source totals for the histogram (received metadata table)
+            per_source = plan.counts.sum(axis=1)
+            self._m.stats.add(ExchangeRecord(
+                shuffle_id=self._h.shuffle_id,
+                plan_s=self._m._plan_seconds.get(self._h.shuffle_id, 0.0),
+                exec_s=t.elapsed,
+                total_records=plan.total_records,
+                record_bytes=out.shape[-1] * 4,
+                num_rounds=plan.num_rounds,
+                per_source_records=per_source,
+            ))
+        del incoming
         return out, totals
 
     def read_partition(self, partition: int) -> np.ndarray:
@@ -165,15 +182,28 @@ class ShuffleReader:
         The SPMD exchange produces all partitions; this is the per-task
         view Spark's reader iterator would have returned.
         """
-        out, totals = self.read()
+        if not self.start_partition <= partition < self.end_partition:
+            raise ValueError(
+                f"partition {partition} outside reader range "
+                f"[{self.start_partition}, {self.end_partition})"
+            )
+        # Segment offsets below assume the unsorted (local partition,
+        # source) layout, so read without key ordering even if this
+        # reader sorts — per-partition slices are cut from the raw layout.
+        out, totals = ShuffleReader(
+            self._m, self._h, self.start_partition, self.end_partition,
+            key_ordering=False,
+        ).read()
         mesh = self._m.runtime.num_partitions
         d, q = partition % mesh, partition // mesh
         plan = self._m._writers[self._h.shuffle_id].plan
         dev_rows = np.asarray(out).reshape(mesh, plan.out_capacity, -1)[d]
-        ppd = self._h.num_parts // mesh
-        # partition q starts after local partitions 0..q-1 of device d
+        # partition starts after device d's earlier *kept* local partitions
         owned = plan.counts.sum(axis=0)
-        start = sum(int(owned[qq * mesh + d]) for qq in range(q))
+        start = sum(
+            int(owned[qq * mesh + d]) for qq in range(q)
+            if self.start_partition <= qq * mesh + d < self.end_partition
+        )
         length = int(owned[partition])
         return dev_rows[start:start + length]
 
@@ -194,6 +224,7 @@ class ShuffleManager:
         self._plan_seconds: dict[int, float] = {}
         self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats)
         self._sort_cache: dict[tuple, Callable] = {}
+        self._filter_cache: dict[tuple, Callable] = {}
 
     # --- SPI ----------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_parts: int,
@@ -224,6 +255,54 @@ class ShuffleManager:
         self.runtime.stop()
 
     # --- helpers ------------------------------------------------------
+    def _filtered(self, out: jax.Array, totals: jax.Array,
+                  plan: ShufflePlan, num_parts: int,
+                  start: int, end: int) -> Tuple[jax.Array, jax.Array]:
+        """Keep only partitions in ``[start, end)`` per device.
+
+        A device's rows are contiguous segments per local partition in
+        ascending global-id order, so the kept set is one contiguous
+        window: roll it to the front, zero the tail, shrink totals. The
+        window geometry comes from the plan (static), passed as data so
+        one compiled program serves every range.
+        """
+        mesh = self.runtime.num_partitions
+        cap = plan.out_capacity
+        owned = plan.counts.sum(axis=0)  # [num_parts]
+        offs = np.zeros((mesh, 2), np.int32)
+        for d in range(mesh):
+            for q in range(num_parts // mesh):
+                p = q * mesh + d
+                if p < start:
+                    offs[d, 0] += int(owned[p])
+                elif p < end:
+                    offs[d, 1] += int(owned[p])
+        window = self.runtime.shard_rows(offs)
+
+        key = (cap, out.shape[-1])
+        fn = self._filter_cache.get(key)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from sparkrdma_tpu.utils.compat import shard_map
+
+            def local_filter(rows, win):
+                off, ln = win[0, 0], win[0, 1]
+                rolled = jnp.roll(rows, -off, axis=0)
+                valid = jnp.arange(cap) < ln
+                return (jnp.where(valid[:, None], rolled, jnp.uint32(0)),
+                        ln[None].astype(jnp.int32))
+
+            fn = jax.jit(shard_map(
+                local_filter, mesh=self.runtime.mesh,
+                in_specs=(P(self.runtime.axis_name),
+                          P(self.runtime.axis_name)),
+                out_specs=(P(self.runtime.axis_name),
+                           P(self.runtime.axis_name)),
+            ))
+            self._filter_cache[key] = fn
+        return fn(out, window)
+
     def _sorted(self, out: jax.Array, totals: jax.Array,
                 plan: ShufflePlan) -> jax.Array:
         """Per-device lexsort of the valid prefix, compiled per geometry."""
@@ -235,10 +314,7 @@ class ShuffleManager:
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
-            try:
-                shard_map = jax.shard_map
-            except AttributeError:  # pragma: no cover
-                from jax.experimental.shard_map import shard_map
+            from sparkrdma_tpu.utils.compat import shard_map
 
             def local_sort(rows, total):
                 valid = jnp.arange(cap) < total[0]

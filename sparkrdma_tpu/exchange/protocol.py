@@ -52,10 +52,7 @@ from sparkrdma_tpu.config import ShuffleConf, size_class
 from sparkrdma_tpu.kernels.bucketing import bucket_records, fill_round_slots
 from sparkrdma_tpu.kernels.sort import compact
 
-try:  # jax >= 0.7 promotes shard_map to the top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from sparkrdma_tpu.utils.compat import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,7 +264,15 @@ class ShuffleExchange:
           - ``incoming``: ``int32[mesh, mesh*ppd... ]`` flattened per-source
             counts table (observability; the received metadata).
         """
-        num_parts = num_parts or self.mesh_size
+        # The plan's counts matrix is the source of truth for geometry —
+        # a mismatched explicit num_parts would silently drop records in
+        # bucket_records' fixed-length bincount.
+        plan_parts = int(plan.counts.shape[1])
+        if num_parts is not None and num_parts != plan_parts:
+            raise ValueError(
+                f"num_parts {num_parts} != plan's {plan_parts}"
+            )
+        num_parts = plan_parts
         w = records.shape[-1]
         key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
                w, getattr(partitioner, "cache_key", id(partitioner)))

@@ -19,10 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.kernels.sort import lexsort_records
 

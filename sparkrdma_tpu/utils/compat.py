@@ -1,0 +1,10 @@
+"""Version-compat shims shared across the package."""
+
+import jax
+
+try:  # jax >= 0.7 promotes shard_map to the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import weakref
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -23,10 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import hash_partitioner
@@ -84,6 +82,13 @@ def _local_join(rows_a, total_a, rows_b, total_b, cap_a, cap_b):
     return count, prods
 
 
+#: Compiled local-join cache, scoped per manager (weak, so dropping the
+#: manager frees its compiled programs) and keyed by capacities —
+#: re-jitting per call would make join_s measure trace+compile.
+_join_cache: "weakref.WeakKeyDictionary[ShuffleManager, Dict[Tuple, Callable]]" \
+    = weakref.WeakKeyDictionary()
+
+
 def run_hash_join(
     manager: ShuffleManager,
     rows_per_device_a: int,
@@ -92,19 +97,23 @@ def run_hash_join(
     seed: int = 0,
     shuffle_ids: Tuple[int, int] = (30, 31),
     verify: bool = True,
+    key_offset_b: int = 0,
 ) -> JoinResult:
+    """``key_offset_b`` shifts B's key range (e.g. by ``key_range`` to make
+    the sides provably disjoint — the zero-match path)."""
     rt = manager.runtime
     mesh = rt.num_partitions
     w = manager.conf.record_words
     rng = np.random.default_rng(seed)
 
-    def gen(n):
+    def gen(n, key_offset):
         x = np.zeros((mesh * n, w), dtype=np.uint32)
-        x[:, 1] = rng.integers(0, key_range, size=mesh * n)  # lo key word
+        x[:, 1] = rng.integers(0, key_range, size=mesh * n) + key_offset
         x[:, 2] = rng.integers(1, 1000, size=mesh * n)       # payload
         return x
 
-    xa, xb = gen(rows_per_device_a), gen(rows_per_device_b)
+    xa = gen(rows_per_device_a, 0)
+    xb = gen(rows_per_device_b, key_offset_b)
     part = hash_partitioner(mesh, manager.conf.key_words)
 
     t0 = time.perf_counter()
@@ -122,15 +131,20 @@ def run_hash_join(
     (oa, ta, ca), (ob, tb, cb) = outs
     ax = rt.axis_name
 
-    def local(rows_a, total_a, rows_b, total_b):
-        c, s = _local_join(rows_a, total_a, rows_b, total_b, ca, cb)
-        return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
+    cache = _join_cache.setdefault(manager, {})
+    cache_key = (ca, cb)
+    joined = cache.get(cache_key)
+    if joined is None:
+        def local(rows_a, total_a, rows_b, total_b):
+            c, s = _local_join(rows_a, total_a, rows_b, total_b, ca, cb)
+            return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
 
-    joined = jax.jit(shard_map(
-        local, mesh=rt.mesh,
-        in_specs=(P(ax), P(ax), P(ax), P(ax)),
-        out_specs=(P(ax), P(ax)),
-    ))
+        joined = jax.jit(shard_map(
+            local, mesh=rt.mesh,
+            in_specs=(P(ax), P(ax), P(ax), P(ax)),
+            out_specs=(P(ax), P(ax)),
+        ))
+        cache[cache_key] = joined
     t0 = time.perf_counter()
     count, prods = joined(oa, ta, ob, tb)
     count = int(np.asarray(count)[0])

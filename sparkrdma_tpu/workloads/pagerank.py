@@ -28,10 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
@@ -108,12 +105,9 @@ def run_pagerank(
     # padding rows go to partition dst=0's owner; they carry zero payload
     plan = ex.plan(base_global, part, mesh)
 
-    # per-device static tables for the update step
-    src_local = jnp.asarray(etab[:, :, 0].reshape(mesh * epad) // mesh,
-                            dtype=jnp.int32)       # index into owner slice
-    src_owner_row = runtime.shard_rows(np.stack(
-        [etab[:, :, 0].reshape(-1) // mesh,
-         (etab[:, :, 0].reshape(-1) % mesh)], axis=1).astype(np.int32))
+    # per-device static table: each edge's src index into the owner slice
+    src_idx = runtime.shard_rows(
+        (etab[:, :, 0].reshape(-1, 1) // mesh).astype(np.int32))
     emask_global = runtime.shard_rows(emask.reshape(-1, 1))
     outdeg_pad = np.ones((vpad,), np.float32)
     outdeg_pad[:v] = outdeg
@@ -170,11 +164,15 @@ def run_pagerank(
     t0 = time.perf_counter()
     ranks = ranks_owner
     for _ in range(iterations):
-        records = build_fn(ranks, base_global, src_owner_row, emask_global,
+        records = build_fn(ranks, base_global, src_idx, emask_global,
                            outdeg_owner)
         out, totals, _ = ex.exchange(records, part, plan, mesh)
         ranks = update_fn(out, totals, outdeg_owner)
-    ranks = jax.block_until_ready(ranks)
+        # Per-iteration barrier: each shuffle iteration is a Spark stage
+        # boundary (BSP). Also keeps the async dispatch queue shallow —
+        # on forced-host CPU meshes, piling up collective programs can
+        # starve XLA's single-core rendezvous scheduler.
+        ranks = jax.block_until_ready(ranks)
     total_s = time.perf_counter() - t0
 
     # owner layout [mesh*vper] -> dense [v]

@@ -70,7 +70,7 @@ def run_repartition(
 
         reader = manager.get_reader(handle)
         if warmup:  # compile outside the timed region, like any TPU bench
-            jax.block_until_ready(reader.read()[0])
+            jax.block_until_ready(reader.read(record_stats=False)[0])
         t0 = time.perf_counter()
         out, totals = reader.read()
         jax.block_until_ready(out)

@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.workloads.als import run_als
+
+
+@pytest.fixture(scope="module")
+def als_runtime():
+    rt = MeshRuntime(ShuffleConf(slot_records=128))
+    yield rt
+    rt.stop()
+
+
+def _random_ratings(rng, num_users, num_items, n, rank=3):
+    """Low-rank ground truth + noise, unique (user, item) pairs."""
+    u_true = rng.standard_normal((num_users, rank))
+    v_true = rng.standard_normal((num_items, rank))
+    pairs = rng.choice(num_users * num_items, size=n, replace=False)
+    uu, ii = pairs // num_items, pairs % num_items
+    rr = np.sum(u_true[uu] * v_true[ii], axis=1) + 0.01 * rng.standard_normal(n)
+    return np.stack([uu, ii, rr], axis=1)
+
+
+def test_als_matches_numpy(als_runtime, rng):
+    ratings = _random_ratings(rng, num_users=40, num_items=24, n=300)
+    res = run_als(als_runtime, ratings, 40, 24, rank=4, iterations=3)
+    assert res.verified
+
+
+def test_als_rmse_decreases(als_runtime, rng):
+    ratings = _random_ratings(rng, num_users=32, num_items=32, n=400)
+    r1 = run_als(als_runtime, ratings, 32, 32, rank=4, iterations=1,
+                 verify=False)
+    r5 = run_als(als_runtime, ratings, 32, 32, rank=4, iterations=6,
+                 verify=False)
+    assert r5.rmse < r1.rmse
+    assert r5.rmse < 0.5  # low-rank data is fittable
+
+
+def test_als_uneven_entities(als_runtime, rng):
+    """Entity counts not divisible by mesh size exercise padding."""
+    ratings = _random_ratings(rng, num_users=13, num_items=9, n=80)
+    res = run_als(als_runtime, ratings, 13, 9, rank=3, iterations=2)
+    assert res.verified
+
+
+def test_als_cold_users(als_runtime, rng):
+    """Users with zero ratings get the pure-regularization solution (zero)."""
+    ratings = _random_ratings(rng, num_users=8, num_items=8, n=30)
+    ratings = ratings[ratings[:, 0] != 5]  # user 5 rates nothing
+    res = run_als(als_runtime, ratings, 8, 8, rank=3, iterations=2)
+    assert res.verified
+    assert np.allclose(res.user_factors[5], 0.0, atol=1e-6)

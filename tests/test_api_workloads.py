@@ -133,3 +133,80 @@ def test_validate_global_sort_rejects_bad():
     out[0] = [2, 0, 0, 0]
     out[4] = [1, 0, 0, 0]  # device 1 starts below device 0's max
     assert not validate_global_sort(out, np.array([1, 1]), x, 2, 4)
+
+
+def test_reader_partition_range_filter(manager, rng):
+    """A narrowed reader keeps only its partitions' rows, like a reduce
+    task reading its assigned range."""
+    part = modulo_partitioner(16, key_word=1)
+    handle = manager.register_shuffle(40, 16, part)
+    x = np.zeros((8 * 24, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, 16, size=8 * 24).astype(np.uint32)
+    x[:, 2] = rng.integers(0, 2**32, size=8 * 24, dtype=np.uint32)
+    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+
+    full_out, full_totals = manager.get_reader(handle).read()
+    assert int(np.asarray(full_totals).sum()) == x.shape[0]
+
+    start, end = 3, 11
+    out, totals = manager.get_reader(handle, start_partition=start,
+                                     end_partition=end).read()
+    expect = int(np.sum((x[:, 1] >= start) & (x[:, 1] < end)))
+    assert int(np.asarray(totals).sum()) == expect
+    # every kept record's key is inside the range
+    plan = manager._writers[40].plan
+    rows = np.asarray(out).reshape(8, plan.out_capacity, -1)
+    t = np.asarray(totals)
+    for d in range(8):
+        keys = rows[d, :int(t[d]), 1]
+        assert np.all((keys >= start) & (keys < end))
+    # read_partition agrees with the filtered layout
+    reader = manager.get_reader(handle, start_partition=start,
+                                end_partition=end)
+    p7 = reader.read_partition(7)
+    assert p7.shape[0] == int(np.sum(x[:, 1] == 7))
+    assert np.all(p7[:, 1] == 7)
+    with pytest.raises(ValueError):
+        reader.read_partition(1)
+    manager.unregister_shuffle(40)
+
+
+def test_exchange_num_parts_must_match_plan(manager, rng):
+    """exchange() derives geometry from the plan; a conflicting num_parts
+    is an error, not silent record loss."""
+    ex = manager._exchange
+    part = modulo_partitioner(16, key_word=1)
+    x = np.zeros((8 * 8, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, 16, size=8 * 8).astype(np.uint32)
+    records = manager.runtime.shard_rows(x)
+    plan = ex.plan(records, part, num_parts=16)
+    out, totals, _ = ex.exchange(records, part, plan)  # derives 16
+    assert int(np.asarray(totals).sum()) == x.shape[0]
+    with pytest.raises(ValueError):
+        ex.exchange(records, part, plan, num_parts=8)
+
+
+def test_read_partition_with_key_ordering(manager, rng):
+    """Partition slicing must use the raw layout even on a sorting reader
+    (keys span a wider range than num_parts so sorted order != partition
+    order)."""
+    part = modulo_partitioner(16, key_word=1)
+    handle = manager.register_shuffle(41, 16, part)
+    x = np.zeros((8 * 24, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, 64, size=8 * 24).astype(np.uint32)
+    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+    reader = manager.get_reader(handle, key_ordering=True)
+    p11 = reader.read_partition(11)
+    assert p11.shape[0] == int(np.sum(x[:, 1] % 16 == 11))
+    assert np.all(p11[:, 1] % 16 == 11)
+    manager.unregister_shuffle(41)
+
+
+def test_reader_rejects_bad_range(manager):
+    part = modulo_partitioner(16, key_word=1)
+    handle = manager.register_shuffle(42, 16, part)
+    for start, end in [(8, 4), (-3, 4), (0, 17), (5, 5)]:
+        with pytest.raises(ValueError):
+            manager.get_reader(handle, start_partition=start,
+                               end_partition=end)
+    manager.unregister_shuffle(42)

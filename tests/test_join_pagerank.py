@@ -25,8 +25,10 @@ def test_hash_join_disjoint_keys(manager):
     """No key overlap -> zero matches (keys of B shifted out of A's range)."""
     res = run_hash_join(manager, rows_per_device_a=16, rows_per_device_b=16,
                         key_range=50, seed=4, shuffle_ids=(32, 33),
-                        verify=False)
-    assert res.matches >= 0  # smoke; exact disjointness needs custom gen
+                        key_offset_b=50)
+    assert res.verified
+    assert res.matches == 0
+    assert res.sum_products == 0.0
 
 
 def test_pagerank_matches_numpy(manager, rng):
