@@ -1,0 +1,279 @@
+// Host staging runtime: aligned size-classed buffer pool + pipelined file
+// spill. C ABI for ctypes.
+//
+// This is the TPU build's native-grade equivalent of the reference's
+// host-side memory/IO machinery (SURVEY.md §2.5): RdmaBufferManager's
+// pre-registered, power-of-two size-classed buffer pools
+// (src/main/java/org/apache/spark/shuffle/rdma/RdmaBufferManager.java
+// §get/§put/§prealloc) become an aligned host-RAM pool feeding
+// host<->HBM staging, and RdmaMappedFile's zero-copy file export
+// (RdmaMappedFile.java §mmap/§getRdmaBlockLocation) becomes a
+// background-threaded spill spooler that persists map outputs so a
+// restarted job can skip the map stage (the "shuffle files survive task
+// death" property the reference inherits from Spark).
+//
+// Design notes:
+// - 256-byte alignment: safe for O_DIRECT-style IO and cache lines, and
+//   matches typical DMA-friendly staging alignment.
+// - Pool classes are powers of two, same rule as the Python SlotPool and
+//   the reference's RdmaBufferManager, so both sides agree on reuse.
+// - The spooler is one writer thread with a bounded queue: submissions
+//   copy nothing (caller keeps the buffer alive until drain), mirroring
+//   how the reference posts work requests referencing registered memory
+//   and completes them asynchronously.
+
+#include <atomic>
+#include <condition_variable>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kAlignment = 256;
+
+size_t size_class(size_t n) {
+  size_t c = kAlignment;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+struct Pool {
+  std::mutex mu;
+  // free stacks per size class (RdmaBufferManager's ConcurrentLinkedDeque
+  // per class)
+  std::unordered_map<size_t, std::vector<void*>> free_lists;
+  // live allocation -> its class, for put()
+  std::unordered_map<void*, size_t> sizes;
+  std::atomic<long> hits{0}, misses{0}, outstanding{0};
+  std::atomic<long> bytes_allocated{0};
+};
+
+struct SpoolTask {
+  std::string path;
+  const void* buf;
+  size_t len;
+};
+
+struct Spooler {
+  std::mutex mu;
+  std::condition_variable cv_submit, cv_done;
+  std::deque<SpoolTask> queue;
+  size_t depth;
+  size_t in_flight = 0;
+  long errors = 0;
+  long completed = 0;
+  bool stopping = false;
+  std::thread worker;
+};
+
+long write_whole_file(const char* path, const void* buf, size_t len) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  const char* p = static_cast<const char*>(buf);
+  size_t left = len;
+  while (left > 0) {
+    ssize_t n = ::write(fd, p, left);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  if (::close(fd) != 0) return -errno;
+  return static_cast<long>(len);
+}
+
+void spool_loop(Spooler* sp) {
+  for (;;) {
+    SpoolTask task;
+    {
+      std::unique_lock<std::mutex> lk(sp->mu);
+      sp->cv_submit.wait(lk, [sp] { return sp->stopping || !sp->queue.empty(); });
+      if (sp->queue.empty()) {
+        if (sp->stopping) return;
+        continue;
+      }
+      task = sp->queue.front();
+      sp->queue.pop_front();
+      sp->in_flight++;
+    }
+    long rc = write_whole_file(task.path.c_str(), task.buf, task.len);
+    {
+      std::lock_guard<std::mutex> lk(sp->mu);
+      if (rc < 0) sp->errors++;
+      sp->completed++;
+      sp->in_flight--;
+    }
+    sp->cv_done.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- alloc
+void* sr_alloc(size_t bytes) {
+  size_t padded = (bytes + kAlignment - 1) / kAlignment * kAlignment;
+  return ::aligned_alloc(kAlignment, padded);
+}
+
+void sr_free(void* p) { ::free(p); }
+
+// ----------------------------------------------------------------- pool
+void* sr_pool_create() { return new Pool(); }
+
+void sr_pool_destroy(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  {
+    // scope the lock: the guard must release p->mu BEFORE delete, or its
+    // destructor unlocks a destroyed mutex inside freed memory
+    std::lock_guard<std::mutex> lk(p->mu);
+    for (auto& kv : p->free_lists)
+      for (void* buf : kv.second) ::free(buf);
+    // leak any outstanding buffers deliberately: freeing memory the
+    // caller still holds would be worse; outstanding() exposes the count
+    p->free_lists.clear();
+    p->sizes.clear();
+  }
+  delete p;
+}
+
+void* sr_pool_get(void* pool, size_t bytes) {
+  Pool* p = static_cast<Pool*>(pool);
+  size_t cls = size_class(bytes);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto it = p->free_lists.find(cls);
+    if (it != p->free_lists.end() && !it->second.empty()) {
+      void* buf = it->second.back();
+      it->second.pop_back();
+      p->hits++;
+      p->outstanding++;
+      p->sizes[buf] = cls;
+      return buf;
+    }
+  }
+  void* buf = ::aligned_alloc(kAlignment, cls);
+  if (buf == nullptr) return nullptr;
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->misses++;
+  p->outstanding++;
+  p->bytes_allocated += static_cast<long>(cls);
+  p->sizes[buf] = cls;
+  return buf;
+}
+
+int sr_pool_put(void* pool, void* buf) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto it = p->sizes.find(buf);
+  if (it == p->sizes.end()) return -1;  // not from this pool / double put
+  size_t cls = it->second;
+  p->sizes.erase(it);
+  p->free_lists[cls].push_back(buf);
+  p->outstanding--;
+  return 0;
+}
+
+size_t sr_pool_class_of(size_t bytes) { return size_class(bytes); }
+
+void sr_pool_stats(void* pool, long* hits, long* misses, long* outstanding,
+                   long* bytes_allocated) {
+  Pool* p = static_cast<Pool*>(pool);
+  *hits = p->hits.load();
+  *misses = p->misses.load();
+  *outstanding = p->outstanding.load();
+  *bytes_allocated = p->bytes_allocated.load();
+}
+
+// -------------------------------------------------------------- file IO
+long sr_write_file(const char* path, const void* buf, size_t len) {
+  return write_whole_file(path, buf, len);
+}
+
+long sr_read_file(const char* path, void* buf, size_t cap) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < cap) {
+    ssize_t n = ::read(fd, p + got, cap - got);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    if (n == 0) break;
+    got += static_cast<size_t>(n);
+  }
+  ::close(fd);
+  return static_cast<long>(got);
+}
+
+long sr_file_size(const char* path) {
+  struct stat st;
+  if (::stat(path, &st) != 0) return -errno;
+  return static_cast<long>(st.st_size);
+}
+
+// -------------------------------------------------------------- spooler
+void* sr_spooler_create(size_t depth) {
+  Spooler* sp = new Spooler();
+  sp->depth = depth == 0 ? 8 : depth;
+  sp->worker = std::thread(spool_loop, sp);
+  return sp;
+}
+
+// Caller must keep `buf` alive until sr_spooler_drain returns.
+int sr_spooler_submit(void* spooler, const char* path, const void* buf,
+                      size_t len) {
+  Spooler* sp = static_cast<Spooler*>(spooler);
+  std::unique_lock<std::mutex> lk(sp->mu);
+  if (sp->stopping) return -1;
+  // bounded queue: block when full (the bytes-in-flight throttle)
+  sp->cv_done.wait(lk, [sp] { return sp->queue.size() < sp->depth; });
+  sp->queue.push_back(SpoolTask{path, buf, len});
+  sp->cv_submit.notify_one();
+  return 0;
+}
+
+// Wait until all submitted writes completed; returns error count so far.
+long sr_spooler_drain(void* spooler) {
+  Spooler* sp = static_cast<Spooler*>(spooler);
+  std::unique_lock<std::mutex> lk(sp->mu);
+  sp->cv_done.wait(lk,
+                   [sp] { return sp->queue.empty() && sp->in_flight == 0; });
+  return sp->errors;
+}
+
+void sr_spooler_destroy(void* spooler) {
+  Spooler* sp = static_cast<Spooler*>(spooler);
+  {
+    std::lock_guard<std::mutex> lk(sp->mu);
+    sp->stopping = true;
+  }
+  sp->cv_submit.notify_all();
+  sp->worker.join();
+  delete sp;
+}
+
+}  // extern "C"

@@ -39,8 +39,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.exchange.errors import FetchFailedError
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
 from sparkrdma_tpu.kernels.sort import lexsort_records
+from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.stats import ExchangeRecord, ShuffleReadStats, Timer
@@ -79,7 +81,13 @@ class ShuffleWriter:
         return self
 
     def stop(self, success: bool = True) -> Optional[ShufflePlan]:
-        """On success: plan (size-exchange) + publish metadata."""
+        """On success: plan (size-exchange) + publish metadata.
+
+        With ``spill_to_host`` and a configured store, the published map
+        output is also persisted host-side — the analogue of shuffle
+        files surviving on disk (a restarted job resumes via
+        :meth:`ShuffleManager.resume_shuffle` without re-running the map).
+        """
         if not success or self._records is None:
             self._records = None
             return None
@@ -90,6 +98,8 @@ class ShuffleWriter:
         self._m._registry.publish_map_output(self._h.shuffle_id,
                                              self._plan.counts)
         self._m._plan_seconds[self._h.shuffle_id] = t.elapsed
+        if self._m.store is not None and self._m.conf.spill_to_host:
+            self._m.checkpoint_shuffle(self._h, writer=self)
         log.debug("shuffle %d map published: %d records, %d rounds",
                   self._h.shuffle_id, self._plan.total_records,
                   self._plan.num_rounds)
@@ -140,26 +150,44 @@ class ShuffleReader:
         ``record_stats=False`` suppresses the stats record (used for
         warmup/compile passes so throughput histograms stay honest).
         """
-        writer = self._m._writers.get(self._h.shuffle_id)
-        if writer is None or writer.records is None or writer.plan is None:
-            raise RuntimeError(
-                f"shuffle {self._h.shuffle_id}: no published map output; "
-                "call get_writer(handle).write(records).stop() first"
-            )
+        writer = self._m._recover_writer(self._h)
         ex = self._m._exchange
-        with Timer() as t:
-            out, totals, incoming = ex.exchange(
-                writer.records, self._h.partitioner, writer.plan,
-                self._h.num_parts
-            )
-            if (self.start_partition, self.end_partition) != (
-                    0, self._h.num_parts):
-                out, totals = self._m._filtered(
-                    out, totals, writer.plan, self._h.num_parts,
-                    self.start_partition, self.end_partition)
-            if self.key_ordering:
-                out = self._m._sorted(out, totals, writer.plan)
-            out = jax.block_until_ready(out)
+        conf = self._m.conf
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                # Timer covers only this attempt, so exec_s excludes
+                # failed attempts and checkpoint reloads — the stats stay
+                # a statement about exchange throughput.
+                with Timer() as t:
+                    out, totals, incoming = ex.exchange(
+                        writer.records, self._h.partitioner, writer.plan,
+                        self._h.num_parts, shuffle_id=self._h.shuffle_id,
+                    )
+                    if (self.start_partition, self.end_partition) != (
+                            0, self._h.num_parts):
+                        out, totals = self._m._filtered(
+                            out, totals, writer.plan, self._h.num_parts,
+                            self.start_partition, self.end_partition)
+                    if self.key_ordering:
+                        out = self._m._sorted(out, totals, writer.plan)
+                    out = jax.block_until_ready(out)
+                break
+            except FetchFailedError as e:
+                # Spark's contract: FetchFailed -> stage retry from
+                # still-available map outputs, bounded by attempts.
+                if attempt >= conf.max_retry_attempts:
+                    raise FetchFailedError(
+                        self._h.shuffle_id,
+                        f"giving up after {attempt} attempts",
+                        attempt,
+                    ) from e
+                log.warning(
+                    "shuffle %d fetch failed (attempt %d/%d): %s; "
+                    "retrying", self._h.shuffle_id, attempt,
+                    conf.max_retry_attempts, e)
+                writer = self._m._recover_writer(self._h)
         plan = writer.plan
         if record_stats:
             # per-source totals for the histogram (received metadata table)
@@ -212,9 +240,14 @@ class ShuffleManager:
     """The SPI root object — one per process, like RdmaShuffleManager."""
 
     def __init__(self, runtime: Optional[MeshRuntime] = None,
-                 conf: Optional[ShuffleConf] = None):
+                 conf: Optional[ShuffleConf] = None,
+                 store: Optional[MapOutputStore] = None):
         self.runtime = runtime or MeshRuntime(conf)
         self.conf = conf or self.runtime.conf
+        if store is None and self.conf.spill_dir:
+            store = MapOutputStore(self.conf.spill_dir,
+                                   use_native=self.conf.use_native_staging)
+        self.store = store
         self._exchange = ShuffleExchange(self.runtime.mesh,
                                          self.runtime.axis_name, self.conf)
         ids = tuple(self.runtime.manager_id(i)
@@ -247,6 +280,87 @@ class ShuffleManager:
         self._registry.unregister(shuffle_id)
         self._writers.pop(shuffle_id, None)
         self._plan_seconds.pop(shuffle_id, None)
+        if self.store is not None:  # shuffle files removed on unregister
+            self.store.delete(shuffle_id)
+
+    # --- durability (checkpoint / resume) -----------------------------
+    def checkpoint_shuffle(self, handle: ShuffleHandle,
+                           writer: Optional[ShuffleWriter] = None) -> None:
+        """Persist the published map output host-side (explicit spill).
+
+        ``writer`` lets a caller checkpoint its own state directly (the
+        stop() path uses this) so a writer displaced from the manager's
+        table by a later ``get_writer`` still checkpoints what it
+        published. Multi-host limitation: if the records span devices
+        this process cannot address, the checkpoint is skipped with a
+        warning (per-process sharded spill is future work), never a
+        mid-stop crash.
+        """
+        if self.store is None:
+            raise RuntimeError("no MapOutputStore configured "
+                               "(set conf.spill_dir or pass store=)")
+        if writer is None:
+            writer = self._writers.get(handle.shuffle_id)
+        if writer is None or writer.records is None or writer.plan is None:
+            raise RuntimeError(
+                f"shuffle {handle.shuffle_id}: nothing published to "
+                "checkpoint")
+        if not writer.records.is_fully_addressable:
+            log.warning(
+                "shuffle %d: records span non-addressable devices; "
+                "skipping host checkpoint (multi-host spill unsupported)",
+                handle.shuffle_id)
+            return
+        self.store.save(handle.shuffle_id, np.asarray(writer.records),
+                        writer.plan, handle.num_parts)
+
+    def resume_shuffle(self, handle: ShuffleHandle) -> ShuffleWriter:
+        """Rebuild a writer's published state from the host checkpoint.
+
+        The restarted job re-registers the shuffle (with the same
+        partitioner — functions are not serialized, matching how a
+        restarted Spark job re-creates its lineage) and this reloads the
+        map output so the map stage is skipped.
+        """
+        if self.store is None:
+            raise RuntimeError("no MapOutputStore configured "
+                               "(set conf.spill_dir or pass store=)")
+        records_np, plan, num_parts = self.store.load(handle.shuffle_id)
+        if num_parts != handle.num_parts:
+            raise ValueError(
+                f"checkpoint has num_parts={num_parts}, handle says "
+                f"{handle.num_parts}")
+        mesh_now = self.runtime.num_partitions
+        if plan.counts.shape[0] != mesh_now:
+            # A stale plan on a resized mesh would silently overflow the
+            # round geometry (fill_round_slots drops the excess).
+            raise ValueError(
+                f"checkpoint was taken on a {plan.counts.shape[0]}-device "
+                f"mesh; current mesh has {mesh_now} devices — re-run the "
+                "map stage instead of resuming")
+        w = ShuffleWriter(self, handle)
+        w._records = self.runtime.shard_rows(records_np)
+        w._plan = plan
+        self._writers[handle.shuffle_id] = w
+        self._plan_seconds[handle.shuffle_id] = 0.0
+        self._registry.publish_map_output(handle.shuffle_id, plan.counts)
+        log.info("shuffle %d resumed from checkpoint: %d records",
+                 handle.shuffle_id, plan.total_records)
+        return w
+
+    def _recover_writer(self, handle: ShuffleHandle) -> ShuffleWriter:
+        """Live writer if its map output is intact, else checkpoint."""
+        writer = self._writers.get(handle.shuffle_id)
+        if (writer is not None and writer.records is not None
+                and writer.plan is not None):
+            return writer
+        if self.store is not None and self.store.contains(handle.shuffle_id):
+            return self.resume_shuffle(handle)
+        raise RuntimeError(
+            f"shuffle {handle.shuffle_id}: no published map output (and "
+            "no checkpoint); call get_writer(handle).write(records).stop() "
+            "first"
+        )
 
     def stop(self) -> None:
         if self.stats.enabled and self.stats.records:

@@ -87,6 +87,7 @@ class ShuffleConf:
 
     # --- host staging / spill ---
     spill_to_host: bool = False
+    spill_dir: str = ""               # checkpoint root (empty = no store)
     use_native_staging: bool = True   # C++ staging pool when available
 
     def __post_init__(self) -> None:

@@ -1,0 +1,33 @@
+"""Failure types for the exchange data plane.
+
+The reference surfaces transport failures as Spark's
+``FetchFailedException`` (RdmaShuffleFetcherIterator's completion-listener
+failure path wraps error CQEs / timeouts and Spark retries the stage —
+SURVEY.md §2.6 elasticity row, §5 failure-detection row). The TPU build
+keeps the same contract at the job level: an exchange that fails raises
+:class:`FetchFailedError`, and the reader retries from still-published
+(or host-persisted) map outputs.
+"""
+
+from __future__ import annotations
+
+
+class FetchFailedError(RuntimeError):
+    """An exchange failed; map outputs are intact, the fetch can be retried.
+
+    Mirrors ``org.apache.spark.shuffle.FetchFailedException`` semantics:
+    raising it does not invalidate the shuffle registration — callers
+    retry the read (Spark: stage retry) up to ``max_retry_attempts``.
+    """
+
+    def __init__(self, shuffle_id: int, message: str = "", attempt: int = 0):
+        self.shuffle_id = shuffle_id
+        self.attempt = attempt
+        super().__init__(
+            f"shuffle {shuffle_id} fetch failed"
+            + (f" (attempt {attempt})" if attempt else "")
+            + (f": {message}" if message else "")
+        )
+
+
+__all__ = ["FetchFailedError"]

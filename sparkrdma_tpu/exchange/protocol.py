@@ -124,6 +124,22 @@ class ShuffleExchange:
         self.mesh_size = int(mesh.shape[axis_name])
         self._exec_cache: Dict[Tuple, Callable] = {}
         self._count_cache: Dict[Tuple, Callable] = {}
+        # Fault injection (SURVEY.md §5: the reference has no fault
+        # tooling in-repo; the build adds the hook the exchange loop
+        # needs for testing job-level retry). ``fault_hook`` (tests)
+        # takes priority over the random ``fault_injection_rate``.
+        self.fault_hook: Optional[Callable[[], bool]] = None
+        self._fault_rng = np.random.default_rng(0xFA17)
+
+    def _maybe_inject_fault(self, shuffle_id: int = -1) -> None:
+        from sparkrdma_tpu.exchange.errors import FetchFailedError
+
+        if self.fault_hook is not None:
+            if self.fault_hook():
+                raise FetchFailedError(shuffle_id, "injected fault (hook)")
+        elif self.conf.fault_injection_rate > 0.0:
+            if self._fault_rng.random() < self.conf.fault_injection_rate:
+                raise FetchFailedError(shuffle_id, "injected fault (rate)")
 
     # ------------------------------------------------------------------
     # phase 1: plan (the metadata fetch)
@@ -247,6 +263,7 @@ class ShuffleExchange:
         partitioner: Callable,
         plan: ShufflePlan,
         num_parts: Optional[int] = None,
+        shuffle_id: int = -1,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Run the planned exchange.
 
@@ -273,6 +290,7 @@ class ShuffleExchange:
                 f"num_parts {num_parts} != plan's {plan_parts}"
             )
         num_parts = plan_parts
+        self._maybe_inject_fault(shuffle_id)
         w = records.shape[-1]
         key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
                w, getattr(partitioner, "cache_key", id(partitioner)))

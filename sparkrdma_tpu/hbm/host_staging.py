@@ -1,0 +1,310 @@
+"""Host staging: native buffer pool + pipelined spill (ctypes bridge).
+
+The reference's entire data plane rests on native host components
+(SURVEY.md §2.5): libdisni/libibverbs post work requests against
+pre-registered host buffers, and RdmaMappedFile serves mmap'd shuffle
+files without copying. On TPU the fabric side of that is XLA's job, but
+the *host* side — staging map outputs to host RAM/disk so they survive
+process death, and feeding them back without re-running the map stage —
+still wants native code. This module bridges to ``native/staging.cpp``:
+
+- :class:`HostBufferPool` — aligned, power-of-two size-classed host
+  buffers (``RdmaBufferManager.get/put`` semantics, same class rule as
+  the device :class:`~sparkrdma_tpu.hbm.slot_pool.SlotPool`);
+- :class:`SpillWriter` — a background writer thread with a bounded queue
+  (the bytes-in-flight throttle) persisting buffers to disk while the
+  caller keeps computing — the overlap the reference gets from async
+  work-request completion;
+- graceful **fallback to numpy/stdlib** when the shared library can't be
+  built (conf.use_native_staging=False forces the fallback).
+
+The library is built on demand with ``make -C native`` the first time it
+is needed; failures degrade silently to the fallback so the framework
+never requires a toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("sparkrdma_tpu.staging")
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libsparkstaging.so"
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_attempted = False
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.sr_alloc.restype = ctypes.c_void_p
+    lib.sr_alloc.argtypes = [ctypes.c_size_t]
+    lib.sr_free.argtypes = [ctypes.c_void_p]
+    lib.sr_pool_create.restype = ctypes.c_void_p
+    lib.sr_pool_destroy.argtypes = [ctypes.c_void_p]
+    lib.sr_pool_get.restype = ctypes.c_void_p
+    lib.sr_pool_get.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.sr_pool_put.restype = ctypes.c_int
+    lib.sr_pool_put.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.sr_pool_class_of.restype = ctypes.c_size_t
+    lib.sr_pool_class_of.argtypes = [ctypes.c_size_t]
+    lib.sr_pool_stats.argtypes = [ctypes.c_void_p] + \
+        [ctypes.POINTER(ctypes.c_long)] * 4
+    lib.sr_write_file.restype = ctypes.c_long
+    lib.sr_write_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                  ctypes.c_size_t]
+    lib.sr_read_file.restype = ctypes.c_long
+    lib.sr_read_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                 ctypes.c_size_t]
+    lib.sr_file_size.restype = ctypes.c_long
+    lib.sr_file_size.argtypes = [ctypes.c_char_p]
+    lib.sr_spooler_create.restype = ctypes.c_void_p
+    lib.sr_spooler_create.argtypes = [ctypes.c_size_t]
+    lib.sr_spooler_submit.restype = ctypes.c_int
+    lib.sr_spooler_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_void_p, ctypes.c_size_t]
+    lib.sr_spooler_drain.restype = ctypes.c_long
+    lib.sr_spooler_drain.argtypes = [ctypes.c_void_p]
+    lib.sr_spooler_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the staging library; None on failure."""
+    global _lib, _lib_attempted
+    with _lib_lock:
+        if _lib is not None or _lib_attempted:
+            return _lib
+        _lib_attempted = True
+        try:
+            if not _LIB_PATH.exists() and build_if_missing:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True, capture_output=True, timeout=120,
+                )
+            if _LIB_PATH.exists():
+                _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
+                log.info("native staging library loaded: %s", _LIB_PATH)
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warning("native staging unavailable (%s); numpy fallback", e)
+            _lib = None
+        return _lib
+
+
+class HostBuffer:
+    """One aligned host buffer (native) or numpy array (fallback)."""
+
+    def __init__(self, nbytes: int, ptr: Optional[int],
+                 pool: "HostBufferPool"):
+        self.nbytes = nbytes
+        self._ptr = ptr
+        self._pool = pool
+        self._released = False
+        if ptr is None:  # fallback
+            self._np = np.empty(nbytes, dtype=np.uint8)
+        else:
+            self._np = np.ctypeslib.as_array(
+                (ctypes.c_uint8 * nbytes).from_address(ptr))
+
+    def view(self, dtype=np.uint8, shape=None) -> np.ndarray:
+        a = self._np.view(dtype)
+        return a if shape is None else a[:int(np.prod(shape))].reshape(shape)
+
+    @property
+    def address(self) -> Optional[int]:
+        return self._ptr
+
+    def release(self) -> None:
+        self._pool.put(self)
+
+
+class HostBufferPool:
+    """Size-classed aligned host buffer pool (RdmaBufferManager analogue)."""
+
+    def __init__(self, use_native: bool = True):
+        self._lib = load_native() if use_native else None
+        self._handle = (self._lib.sr_pool_create()
+                        if self._lib is not None else None)
+        # fallback free stacks
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._fb_hits = 0
+        self._fb_misses = 0
+        self._lock = threading.Lock()
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    @staticmethod
+    def size_class(nbytes: int) -> int:
+        c = 256
+        while c < nbytes:
+            c <<= 1
+        return c
+
+    def get(self, nbytes: int) -> HostBuffer:
+        cls = self.size_class(nbytes)
+        if self._handle is not None:
+            ptr = self._lib.sr_pool_get(self._handle, cls)
+            if not ptr:
+                raise MemoryError(f"host pool allocation of {cls} B failed")
+            return HostBuffer(cls, ptr, self)
+        with self._lock:
+            stack = self._free.get(cls)
+            if stack:
+                arr = stack.pop()
+                self._fb_hits += 1
+            else:
+                arr = np.empty(cls, dtype=np.uint8)
+                self._fb_misses += 1
+        buf = HostBuffer.__new__(HostBuffer)
+        buf.nbytes = cls
+        buf._ptr = None
+        buf._pool = self
+        buf._np = arr
+        buf._released = False
+        return buf
+
+    def put(self, buf: HostBuffer) -> None:
+        if getattr(buf, "_released", False):
+            raise ValueError("buffer already released")
+        buf._released = True
+        if self._handle is not None and buf._ptr is not None:
+            rc = self._lib.sr_pool_put(self._handle,
+                                       ctypes.c_void_p(buf._ptr))
+            if rc != 0:
+                raise ValueError("buffer not owned by pool (double release?)")
+            buf._ptr = None
+            return
+        with self._lock:
+            self._free.setdefault(buf.nbytes, []).append(buf._np)
+
+    def stats(self) -> Dict[str, int]:
+        if self._handle is not None:
+            vals = [ctypes.c_long() for _ in range(4)]
+            self._lib.sr_pool_stats(self._handle, *[ctypes.byref(v)
+                                                    for v in vals])
+            return {"hits": vals[0].value, "misses": vals[1].value,
+                    "outstanding": vals[2].value,
+                    "bytes_allocated": vals[3].value, "native": 1}
+        with self._lock:
+            return {"hits": self._fb_hits, "misses": self._fb_misses,
+                    "outstanding": -1, "bytes_allocated": -1, "native": 0}
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.sr_pool_destroy(self._handle)
+            self._handle = None
+        self._free.clear()
+
+
+class SpillWriter:
+    """Pipelined spill-to-disk: submit arrays, keep computing, drain once.
+
+    Native path: a C++ writer thread with a bounded queue writes each
+    buffer while the caller proceeds (submissions hold a reference to the
+    source array so its memory stays alive until drain). Fallback: the
+    same contract via a Python thread.
+    """
+
+    def __init__(self, depth: int = 8, use_native: bool = True):
+        self._lib = load_native() if use_native else None
+        self._pending: List[np.ndarray] = []  # keep-alive until drain
+        if self._lib is not None:
+            self._handle = self._lib.sr_spooler_create(depth)
+            self._fb = None
+        else:
+            self._handle = None
+            import queue as _q
+
+            self._fb_q: "_q.Queue" = _q.Queue(maxsize=depth)
+            self._fb_errors = 0
+            self._fb = threading.Thread(target=self._fb_loop, daemon=True)
+            self._fb.start()
+
+    def _fb_loop(self) -> None:
+        while True:
+            item = self._fb_q.get()
+            if item is None:
+                self._fb_q.task_done()
+                return
+            path, arr = item
+            try:
+                arr.tofile(path)
+            except OSError:
+                self._fb_errors += 1
+            self._fb_q.task_done()
+
+    def submit(self, path: str, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        self._pending.append(arr)  # keep alive
+        if self._handle is not None:
+            rc = self._lib.sr_spooler_submit(
+                self._handle, path.encode(), arr.ctypes.data, arr.nbytes)
+            if rc != 0:
+                raise RuntimeError("spooler stopped")
+        else:
+            self._fb_q.put((path, arr))
+
+    def drain(self) -> int:
+        """Block until all writes land; return error count; drop refs."""
+        if self._handle is not None:
+            errors = int(self._lib.sr_spooler_drain(self._handle))
+        else:
+            self._fb_q.join()
+            errors = self._fb_errors
+        self._pending.clear()
+        return errors
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.sr_spooler_drain(self._handle)
+            self._lib.sr_spooler_destroy(self._handle)
+            self._handle = None
+        elif self._fb is not None:
+            self._fb_q.put(None)
+            self._fb.join(timeout=10)
+            self._fb = None
+        self._pending.clear()
+
+
+def write_array(path: str, arr: np.ndarray, use_native: bool = True) -> None:
+    """Synchronous single-array spill."""
+    arr = np.ascontiguousarray(arr)
+    lib = load_native() if use_native else None
+    if lib is not None:
+        rc = lib.sr_write_file(path.encode(), arr.ctypes.data, arr.nbytes)
+        if rc != arr.nbytes:
+            raise OSError(f"native write to {path} failed: rc={rc}")
+    else:
+        arr.tofile(path)
+
+
+def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
+    """Read back a spilled array of known dtype/shape."""
+    out = np.empty(shape, dtype=dtype)
+    lib = load_native() if use_native else None
+    if lib is not None:
+        rc = lib.sr_read_file(path.encode(), out.ctypes.data, out.nbytes)
+        if rc != out.nbytes:
+            raise OSError(f"native read of {path} short: rc={rc}")
+    else:
+        data = np.fromfile(path, dtype=dtype)
+        if data.size != int(np.prod(shape)):
+            raise OSError(f"spill file {path} has wrong size")
+        out = data.reshape(shape)
+    return out
+
+
+__all__ = ["HostBufferPool", "HostBuffer", "SpillWriter", "write_array",
+           "read_array", "load_native"]

@@ -1,0 +1,137 @@
+"""Host persistence of map outputs — checkpoint/resume of the map stage.
+
+The reference gets durability for free: map outputs are ordinary shuffle
+files on local disk, which survive task death and are re-servable without
+re-running the map stage (SURVEY.md §5 checkpoint row — "Spark lineage +
+shuffle files on disk are the implicit checkpoint"; RdmaMappedFile simply
+re-registers them). SPMD jobs lose that by default — map outputs live in
+HBM and die with the process — so this module restores the property
+explicitly: :class:`MapOutputStore` persists a shuffle's published records
+and plan to host disk (via the native staging spooler when available) and
+reloads them so a restarted job skips the map stage entirely.
+
+What is persisted is the map-side *input to the exchange* (records +
+counts matrix), not the exchange output: that matches the reference,
+where what survives is the map output files, and the fetch re-runs.
+
+Partitioner functions are not serialized — a resuming job re-registers
+the shuffle with the same partitioner (exactly as a restarted Spark job
+re-creates its RDD lineage) and only the data + plan are reloaded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import shutil
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.exchange.protocol import ShufflePlan
+from sparkrdma_tpu.hbm.host_staging import SpillWriter, read_array
+
+log = logging.getLogger("sparkrdma_tpu.checkpoint")
+
+_META = "meta.json"
+_RECORDS = "records.u32"
+
+
+class MapOutputStore:
+    """Directory-backed store: one subdir per shuffle id."""
+
+    def __init__(self, root: str, use_native: bool = True,
+                 spool_depth: int = 4):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.use_native = use_native
+        self.spool_depth = spool_depth
+
+    # ------------------------------------------------------------------
+    def _dir(self, shuffle_id: int) -> Path:
+        return self.root / f"shuffle_{shuffle_id}"
+
+    def save(self, shuffle_id: int, records: np.ndarray, plan: ShufflePlan,
+             num_parts: int) -> Path:
+        """Persist records + plan. Overwrites any previous checkpoint.
+
+        The records write is pipelined through the staging spooler (the
+        map task keeps going while bytes land), then metadata is written
+        last so a checkpoint is only visible once complete — the
+        data-then-index ordering shuffle files use.
+        """
+        d = self._dir(shuffle_id)
+        tmp = d.with_suffix(".tmp")
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        records = np.ascontiguousarray(records, dtype=np.uint32)
+        spool = SpillWriter(depth=self.spool_depth,
+                            use_native=self.use_native)
+        try:
+            spool.submit(str(tmp / _RECORDS), records)
+            errors = spool.drain()
+        finally:
+            spool.close()
+        if errors:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise OSError(f"spill of shuffle {shuffle_id} failed "
+                          f"({errors} errors)")
+        meta = {
+            "shuffle_id": shuffle_id,
+            "num_parts": num_parts,
+            "shape": list(records.shape),
+            "counts": plan.counts.tolist(),
+            "num_rounds": plan.num_rounds,
+            "out_capacity": plan.out_capacity,
+            "capacity": plan.capacity,
+        }
+        (tmp / _META).write_text(json.dumps(meta))
+        if d.exists():
+            shutil.rmtree(d)
+        tmp.rename(d)
+        log.info("checkpointed shuffle %d: %s records -> %s",
+                 shuffle_id, records.shape, d)
+        return d
+
+    def load(self, shuffle_id: int) -> Tuple[np.ndarray, ShufflePlan, int]:
+        """Returns ``(records, plan, num_parts)``; KeyError if absent."""
+        d = self._dir(shuffle_id)
+        meta_path = d / _META
+        if not meta_path.exists():
+            raise KeyError(f"no checkpoint for shuffle {shuffle_id} "
+                           f"under {self.root}")
+        meta = json.loads(meta_path.read_text())
+        records = read_array(str(d / _RECORDS), np.uint32,
+                             tuple(meta["shape"]),
+                             use_native=self.use_native)
+        plan = ShufflePlan(
+            counts=np.asarray(meta["counts"], dtype=np.int64),
+            num_rounds=int(meta["num_rounds"]),
+            out_capacity=int(meta["out_capacity"]),
+            capacity=int(meta["capacity"]),
+        )
+        return records, plan, int(meta["num_parts"])
+
+    def contains(self, shuffle_id: int) -> bool:
+        return (self._dir(shuffle_id) / _META).exists()
+
+    def delete(self, shuffle_id: int) -> None:
+        d = self._dir(shuffle_id)
+        if d.exists():
+            shutil.rmtree(d)
+
+    def list_shuffles(self) -> List[int]:
+        out = []
+        for p in self.root.glob("shuffle_*"):
+            if (p / _META).exists():
+                try:
+                    out.append(int(p.name.split("_", 1)[1]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+
+__all__ = ["MapOutputStore"]

@@ -1,0 +1,115 @@
+"""Fault injection, job-level retry, checkpoint/resume of the map stage.
+
+The reference's failure contract (SURVEY.md §2.6/§5): transport errors
+surface as FetchFailedException, Spark retries the stage, and map outputs
+survive on disk so the map stage is not re-run. These tests pin the same
+three properties onto the TPU build.
+"""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.errors import FetchFailedError
+from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+
+
+def _write(manager, handle, rng, n_per_dev=16):
+    x = np.zeros((8 * n_per_dev, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, handle.num_parts, size=8 * n_per_dev)
+    x[:, 2] = rng.integers(0, 2**32, size=8 * n_per_dev, dtype=np.uint32)
+    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+    return x
+
+
+def test_transient_fault_retried(rng):
+    """Two injected failures, then success — data arrives intact."""
+    conf = ShuffleConf(slot_records=64, max_retry_attempts=5)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(0, 8, modulo_partitioner(8, key_word=1))
+        x = _write(m, handle, rng)
+        fails = iter([True, True, False])
+        m._exchange.fault_hook = lambda: next(fails, False)
+        out, totals = m.get_reader(handle).read()
+        assert int(np.asarray(totals).sum()) == x.shape[0]
+        m._exchange.fault_hook = None
+
+
+def test_persistent_fault_raises_after_max_attempts(rng):
+    conf = ShuffleConf(slot_records=64, max_retry_attempts=3)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(1, 8, modulo_partitioner(8, key_word=1))
+        _write(m, handle, rng)
+        m._exchange.fault_hook = lambda: True
+        with pytest.raises(FetchFailedError) as ei:
+            m.get_reader(handle).read()
+        assert ei.value.attempt == 3
+        m._exchange.fault_hook = None
+
+
+def test_fault_rate_zero_never_fires(rng):
+    conf = ShuffleConf(slot_records=64, fault_injection_rate=0.0)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(2, 8, modulo_partitioner(8, key_word=1))
+        x = _write(m, handle, rng)
+        out, totals = m.get_reader(handle).read()
+        assert int(np.asarray(totals).sum()) == x.shape[0]
+
+
+def test_checkpoint_resume_skips_map_stage(tmp_path, rng):
+    """Write+checkpoint in one manager; a fresh manager (restarted job)
+    re-registers and resumes, and the read matches — map stage skipped."""
+    conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                       spill_dir=str(tmp_path / "ckpt"))
+    part = modulo_partitioner(8, key_word=1)
+
+    m1 = ShuffleManager(MeshRuntime(conf), conf)
+    handle = m1.register_shuffle(3, 8, part)
+    x = _write(m1, handle, rng)
+    out1, tot1 = m1.get_reader(handle).read()
+    ref_out, ref_tot = np.asarray(out1), np.asarray(tot1)
+    # process "dies" without unregistering: checkpoint must survive stop()
+    m1._writers.clear()
+    m1.runtime.stop()
+
+    m2 = ShuffleManager(MeshRuntime(conf), conf)
+    handle2 = m2.register_shuffle(3, 8, part)
+    m2.resume_shuffle(handle2)
+    out2, tot2 = m2.get_reader(handle2).read()
+    assert np.array_equal(np.asarray(tot2), ref_tot)
+    assert np.array_equal(np.asarray(out2), ref_out)
+    m2.stop()
+
+
+def test_reader_autorecovers_from_checkpoint(tmp_path, rng):
+    """Lost in-HBM map output (records dropped) -> read() transparently
+    restores from the host checkpoint instead of failing."""
+    conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                       spill_dir=str(tmp_path / "ckpt2"))
+    part = modulo_partitioner(8, key_word=1)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(4, 8, part)
+        x = _write(m, handle, rng)
+        m._writers.clear()  # simulate losing the device-resident output
+        out, totals = m.get_reader(handle).read()
+        assert int(np.asarray(totals).sum()) == x.shape[0]
+
+
+def test_no_checkpoint_no_map_output_raises(rng):
+    conf = ShuffleConf(slot_records=64)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(5, 8, modulo_partitioner(8, key_word=1))
+        with pytest.raises(RuntimeError, match="no published map output"):
+            m.get_reader(handle).read()
+
+
+def test_unregister_deletes_checkpoint(tmp_path, rng):
+    conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                       spill_dir=str(tmp_path / "ckpt3"))
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(6, 8, modulo_partitioner(8, key_word=1))
+        _write(m, handle, rng)
+        assert m.store.contains(6)
+        m.unregister_shuffle(6)
+        assert not m.store.contains(6)

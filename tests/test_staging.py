@@ -1,0 +1,105 @@
+"""Host staging: native pool, spill spooler, checkpoint store."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.exchange.protocol import ShufflePlan
+from sparkrdma_tpu.hbm.host_staging import (HostBufferPool, SpillWriter,
+                                            load_native, read_array,
+                                            write_array)
+from sparkrdma_tpu.meta.checkpoint import MapOutputStore
+
+
+@pytest.fixture(params=[True, False], ids=["native", "fallback"])
+def use_native(request):
+    if request.param and load_native() is None:
+        pytest.skip("native staging library unavailable")
+    return request.param
+
+
+def test_pool_size_class_reuse(use_native):
+    pool = HostBufferPool(use_native=use_native)
+    try:
+        assert pool.native == use_native
+        b = pool.get(1000)
+        assert b.nbytes == 1024  # power-of-two class
+        v = b.view(np.uint32, (256,))
+        v[:] = np.arange(256, dtype=np.uint32)
+        assert int(v.sum()) == 255 * 256 // 2
+        b.release()
+        b2 = pool.get(900)  # same class -> pooled hit
+        st = pool.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        b2.release()
+    finally:
+        pool.close()
+
+
+def test_pool_rejects_foreign_release():
+    if load_native() is None:
+        pytest.skip("native staging library unavailable")
+    pool = HostBufferPool(use_native=True)
+    try:
+        b = pool.get(64)
+        b.release()
+        with pytest.raises(ValueError):
+            pool.put(b)  # double release
+    finally:
+        pool.close()
+
+
+def test_write_read_roundtrip(tmp_path, use_native, rng):
+    x = rng.integers(0, 2**32, size=(513, 4), dtype=np.uint32)
+    p = str(tmp_path / "x.bin")
+    write_array(p, x, use_native=use_native)
+    y = read_array(p, np.uint32, (513, 4), use_native=use_native)
+    assert np.array_equal(x, y)
+
+
+def test_spill_writer_pipelined(tmp_path, use_native, rng):
+    sw = SpillWriter(depth=3, use_native=use_native)
+    try:
+        arrs = [rng.integers(0, 255, size=(10000 + i,), dtype=np.uint8)
+                for i in range(12)]
+        for i, a in enumerate(arrs):
+            sw.submit(str(tmp_path / f"a{i}.bin"), a)
+        assert sw.drain() == 0
+        for i, a in enumerate(arrs):
+            back = read_array(str(tmp_path / f"a{i}.bin"), np.uint8, a.shape,
+                              use_native=use_native)
+            assert np.array_equal(back, a)
+    finally:
+        sw.close()
+
+
+def test_map_output_store_roundtrip(tmp_path, use_native, rng):
+    store = MapOutputStore(str(tmp_path / "ckpt"), use_native=use_native)
+    records = rng.integers(0, 2**32, size=(256, 4), dtype=np.uint32)
+    plan = ShufflePlan(
+        counts=np.arange(16, dtype=np.int64).reshape(8, 2),
+        num_rounds=3, out_capacity=64, capacity=8,
+    )
+    store.save(7, records, plan, num_parts=2)
+    assert store.contains(7)
+    assert store.list_shuffles() == [7]
+    back, plan2, num_parts = store.load(7)
+    assert np.array_equal(back, records)
+    assert np.array_equal(plan2.counts, plan.counts)
+    assert (plan2.num_rounds, plan2.out_capacity, plan2.capacity,
+            num_parts) == (3, 64, 8, 2)
+    store.delete(7)
+    assert not store.contains(7)
+    with pytest.raises(KeyError):
+        store.load(7)
+
+
+def test_store_overwrite_is_atomic(tmp_path, rng):
+    store = MapOutputStore(str(tmp_path / "ckpt"), use_native=False)
+    plan = ShufflePlan(counts=np.ones((8, 8), np.int64), num_rounds=1,
+                       out_capacity=16, capacity=8)
+    a = rng.integers(0, 100, size=(64, 4), dtype=np.uint32)
+    b = rng.integers(0, 100, size=(32, 4), dtype=np.uint32)
+    store.save(1, a, plan, 8)
+    store.save(1, b, plan, 8)  # overwrite with different shape
+    back, _, _ = store.load(1)
+    assert np.array_equal(back, b)
