@@ -28,9 +28,10 @@ def main() -> None:
     from sparkrdma_tpu.workloads.terasort import run_terasort
 
     mesh_size = len(jax.devices())
-    # slot capacity sized so a balanced shuffle fits in ~1 round with
-    # headroom for 2x skew
-    slot = max(4096, (2 * records_per_device) // max(1, mesh_size))
+    # slot capacity sized so a balanced shuffle fits in one round: the
+    # worst (src, dst) pair count under mesh-way range partitioning is
+    # ~records_per_device (everything on one source bound for one dest)
+    slot = max(4096, records_per_device)
     conf = ShuffleConf(slot_records=slot,
                        max_rounds=64,
                        collect_shuffle_read_stats=False)

@@ -41,11 +41,12 @@ import numpy as np
 from sparkrdma_tpu.config import ShuffleConf
 from sparkrdma_tpu.exchange.errors import FetchFailedError
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
-from sparkrdma_tpu.kernels.sort import lexsort_records
+from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
-from sparkrdma_tpu.utils.stats import ExchangeRecord, ShuffleReadStats, Timer
+from sparkrdma_tpu.utils.stats import (ExchangeRecord, ShuffleReadStats,
+                                       Timer, barrier)
 
 log = logging.getLogger("sparkrdma_tpu.api")
 
@@ -138,9 +139,10 @@ class ShuffleReader:
     def read(self, record_stats: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Execute the planned exchange; return ``(records, totals)``.
 
-        ``records``: ``uint32[mesh * out_capacity, W]`` sharded over the
-        mesh, each device's rows = its received partitions, grouped by
-        (local partition, source), zero-padded to ``totals`` per device.
+        ``records``: columnar ``uint32[W, mesh * out_capacity]`` sharded
+        over the record axis; each device's columns = its received
+        partitions, grouped by (local partition, source), zero-padded to
+        ``totals`` per device. Use ``runtime.host_rows`` for a row view.
         A partition range narrower than the full handle keeps only those
         partitions' rows per device (totals shrink accordingly) — the
         reduce-task partition-range view of Spark's getReader. With
@@ -160,19 +162,25 @@ class ShuffleReader:
                 # Timer covers only this attempt, so exec_s excludes
                 # failed attempts and checkpoint reloads — the stats stay
                 # a statement about exchange throughput.
+                filtered = (self.start_partition, self.end_partition) != (
+                    0, self._h.num_parts)
+                # Full-range sorted reads fuse the sort into the exchange
+                # program (one dispatch); a partition filter must apply
+                # first, so the sort stays a separate program there.
+                fuse_sort = self.key_ordering and not filtered
                 with Timer() as t:
                     out, totals, incoming = ex.exchange(
                         writer.records, self._h.partitioner, writer.plan,
                         self._h.num_parts, shuffle_id=self._h.shuffle_id,
+                        sort_key_words=(conf.key_words if fuse_sort else 0),
                     )
-                    if (self.start_partition, self.end_partition) != (
-                            0, self._h.num_parts):
+                    if filtered:
                         out, totals = self._m._filtered(
                             out, totals, writer.plan, self._h.num_parts,
                             self.start_partition, self.end_partition)
-                    if self.key_ordering:
-                        out = self._m._sorted(out, totals, writer.plan)
-                    out = jax.block_until_ready(out)
+                        if self.key_ordering:
+                            out = self._m._sorted(out, totals, writer.plan)
+                    barrier(out)
                 break
             except FetchFailedError as e:
                 # Spark's contract: FetchFailed -> stage retry from
@@ -197,7 +205,7 @@ class ShuffleReader:
                 plan_s=self._m._plan_seconds.get(self._h.shuffle_id, 0.0),
                 exec_s=t.elapsed,
                 total_records=plan.total_records,
-                record_bytes=out.shape[-1] * 4,
+                record_bytes=out.shape[0] * 4,
                 num_rounds=plan.num_rounds,
                 per_source_records=per_source,
             ))
@@ -225,7 +233,8 @@ class ShuffleReader:
         mesh = self._m.runtime.num_partitions
         d, q = partition % mesh, partition // mesh
         plan = self._m._writers[self._h.shuffle_id].plan
-        dev_rows = np.asarray(out).reshape(mesh, plan.out_capacity, -1)[d]
+        cap = plan.out_capacity
+        dev_cols = np.asarray(out)[:, d * cap:(d + 1) * cap]   # [W, cap]
         # partition starts after device d's earlier *kept* local partitions
         owned = plan.counts.sum(axis=0)
         start = sum(
@@ -233,7 +242,7 @@ class ShuffleReader:
             if self.start_partition <= qq * mesh + d < self.end_partition
         )
         length = int(owned[partition])
-        return dev_rows[start:start + length]
+        return np.ascontiguousarray(dev_cols[:, start:start + length].T)
 
 
 class ShuffleManager:
@@ -339,7 +348,10 @@ class ShuffleManager:
                 f"mesh; current mesh has {mesh_now} devices — re-run the "
                 "map stage instead of resuming")
         w = ShuffleWriter(self, handle)
-        w._records = self.runtime.shard_rows(records_np)
+        # checkpoints store the columnar [W, N] batch; reshard over N
+        w._records = jax.device_put(
+            records_np,
+            self.runtime.sharding(None, self.runtime.axis_name))
         w._plan = plan
         self._writers[handle.shuffle_id] = w
         self._plan_seconds[handle.shuffle_id] = 0.0
@@ -393,26 +405,26 @@ class ShuffleManager:
                     offs[d, 1] += int(owned[p])
         window = self.runtime.shard_rows(offs)
 
-        key = (cap, out.shape[-1])
+        key = (cap, out.shape[0])
         fn = self._filter_cache.get(key)
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
             from sparkrdma_tpu.utils.compat import shard_map
 
-            def local_filter(rows, win):
+            ax = self.runtime.axis_name
+
+            def local_filter(cols, win):
                 off, ln = win[0, 0], win[0, 1]
-                rolled = jnp.roll(rows, -off, axis=0)
+                rolled = jnp.roll(cols, -off, axis=1)
                 valid = jnp.arange(cap) < ln
-                return (jnp.where(valid[:, None], rolled, jnp.uint32(0)),
+                return (jnp.where(valid[None, :], rolled, jnp.uint32(0)),
                         ln[None].astype(jnp.int32))
 
             fn = jax.jit(shard_map(
                 local_filter, mesh=self.runtime.mesh,
-                in_specs=(P(self.runtime.axis_name),
-                          P(self.runtime.axis_name)),
-                out_specs=(P(self.runtime.axis_name),
-                           P(self.runtime.axis_name)),
+                in_specs=(P(None, ax), P(ax)),
+                out_specs=(P(None, ax), P(ax)),
             ))
             self._filter_cache[key] = fn
         return fn(out, window)
@@ -422,7 +434,7 @@ class ShuffleManager:
         """Per-device lexsort of the valid prefix, compiled per geometry."""
         key_words = self.conf.key_words
         cap = plan.out_capacity
-        w = out.shape[-1]
+        w = out.shape[0]
         key = (cap, w, key_words)
         fn = self._sort_cache.get(key)
         if fn is None:
@@ -430,14 +442,16 @@ class ShuffleManager:
 
             from sparkrdma_tpu.utils.compat import shard_map
 
-            def local_sort(rows, total):
+            ax = self.runtime.axis_name
+
+            def local_sort(cols, total):
                 valid = jnp.arange(cap) < total[0]
-                return lexsort_records(rows, key_words, valid)
+                return lexsort_cols(cols, key_words, valid)
 
             fn = jax.jit(shard_map(
                 local_sort, mesh=self.runtime.mesh,
-                in_specs=(P(self.runtime.axis_name), P(self.runtime.axis_name)),
-                out_specs=P(self.runtime.axis_name),
+                in_specs=(P(None, ax), P(ax)),
+                out_specs=P(None, ax),
             ))
             self._sort_cache[key] = fn
         return fn(out, totals)

@@ -7,8 +7,10 @@ int32[n]`` carried into the compiled exchange. Each carries a stable
 ``cache_key`` so :class:`~sparkrdma_tpu.exchange.protocol.ShuffleExchange`
 can key its compiled-program cache on partitioner identity.
 
-Records are ``uint32[N, W]`` with the key in the leading ``key_words``
-columns, most-significant word first.
+Record batches are COLUMNAR on device: ``uint32[W, N]`` with the key in
+the leading ``key_words`` rows, most-significant word first (see
+``MeshRuntime.shard_records`` for why). ``records[w]`` is word ``w`` of
+every record — a contiguous full-lane vector.
 """
 
 from __future__ import annotations
@@ -35,9 +37,9 @@ def hash_partitioner(num_parts: int, key_words: int = 2) -> Callable:
     """
 
     def part(records: jax.Array) -> jax.Array:
-        h = jnp.zeros(records.shape[0], dtype=jnp.uint32)
+        h = jnp.zeros(records.shape[1], dtype=jnp.uint32)
         for w in range(key_words):
-            h = (h ^ records[:, w]) * jnp.uint32(2654435761)
+            h = (h ^ records[w]) * jnp.uint32(2654435761)
         h = h ^ (h >> 16)
         return (h % jnp.uint32(num_parts)).astype(jnp.int32)
 
@@ -49,7 +51,7 @@ def modulo_partitioner(num_parts: int, key_word: int = 0) -> Callable:
     reason about in tests (the reference's tests-by-workload equivalent)."""
 
     def part(records: jax.Array) -> jax.Array:
-        return (records[:, key_word] % jnp.uint32(num_parts)).astype(jnp.int32)
+        return (records[key_word] % jnp.uint32(num_parts)).astype(jnp.int32)
 
     return _tag(part, ("mod", num_parts, key_word))
 
@@ -73,13 +75,13 @@ def range_partitioner(splitters: np.ndarray, key_words: int = 2) -> Callable:
     num_parts = int(spl.shape[0]) + 1
 
     def part(records: jax.Array) -> jax.Array:
-        n = records.shape[0]
-        # lexicographic records[i] >= spl[j]: strictly greater at the first
-        # differing word, or equal throughout
+        n = records.shape[1]
+        # lexicographic records[:, i] >= spl[j]: strictly greater at the
+        # first differing word, or equal throughout
         gt = jnp.zeros((n, num_parts - 1), dtype=bool)
         eq = jnp.ones((n, num_parts - 1), dtype=bool)
         for w in range(key_words):
-            rw = records[:, w][:, None]
+            rw = records[w][:, None]
             sw = spl[None, :, w]
             gt = gt | (eq & (rw > sw))
             eq = eq & (rw == sw)

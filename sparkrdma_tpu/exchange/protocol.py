@@ -49,8 +49,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkrdma_tpu.config import ShuffleConf, size_class
-from sparkrdma_tpu.kernels.bucketing import bucket_records, fill_round_slots
-from sparkrdma_tpu.kernels.sort import compact
+from sparkrdma_tpu.kernels.bucketing import (bucket_records, compact_segments,
+                                             fill_round_slots)
 
 from sparkrdma_tpu.utils.compat import shard_map
 
@@ -89,7 +89,11 @@ def _device_partition_counts(counts_local, num_parts, mesh_size, axis_name):
 
 def _make_count_fn(mesh: Mesh, axis_name: str, num_parts: int,
                    partitioner: Callable) -> Callable:
-    """Build the planning step: global records -> global counts matrix."""
+    """Build the planning step: global records -> global counts matrix.
+
+    Records are columnar ``[W, N]`` sharded over ``N`` (see
+    ``MeshRuntime.shard_records``).
+    """
 
     def local_counts(records):
         pids = partitioner(records).astype(jnp.int32)
@@ -100,7 +104,7 @@ def _make_count_fn(mesh: Mesh, axis_name: str, num_parts: int,
         shard_map(
             local_counts,
             mesh=mesh,
-            in_specs=(P(axis_name),),
+            in_specs=(P(None, axis_name),),
             out_specs=P(axis_name),
         )
     )
@@ -159,6 +163,7 @@ class ShuffleExchange:
         map-output table before issuing READs" step.
         """
         num_parts = num_parts or self.mesh_size
+        explicit_capacity = capacity
         capacity = capacity or self.conf.slot_records
         if num_parts % self.mesh_size:
             raise ValueError(
@@ -173,6 +178,15 @@ class ShuffleExchange:
             self._count_cache[key] = fn
         counts = np.asarray(jax.device_get(fn(records))).astype(np.int64)
         per_pair_max = int(counts.max(initial=0))
+        if explicit_capacity is None:
+            # Auto-size the slot to the measured worst (src, dst) pair,
+            # capped by conf.slot_records (the maxAggBlock ceiling): a
+            # balanced shuffle then pads almost nothing, while skew
+            # streams in slot_records-sized rounds. Power-of-two classes
+            # bound the number of compiled geometries (same rule as the
+            # buffer pools).
+            capacity = min(size_class(max(1, per_pair_max)),
+                           self.conf.slot_records)
         num_rounds = max(1, math.ceil(per_pair_max / capacity))
         if num_rounds > self.conf.max_rounds:
             raise ValueError(
@@ -198,15 +212,20 @@ class ShuffleExchange:
     # ------------------------------------------------------------------
     def _build_exec(self, num_parts: int, capacity: int, num_rounds: int,
                     out_capacity: int, record_words: int,
-                    partitioner: Callable) -> Callable:
+                    partitioner: Callable,
+                    sort_key_words: int = 0) -> Callable:
+        """``sort_key_words > 0`` fuses the reduce-side key-ordering sort
+        into the same compiled program (one dispatch, one XLA schedule —
+        the RdmaShuffleReader's ExternalSorter stage inlined)."""
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
 
         def local_step(records):
             # --- map side: bucket into per-partition runs -------------
+            # records: columnar [W, n_local]
             pids = partitioner(records).astype(jnp.int32)
-            sr, sp, counts, offs = bucket_records(records, pids, num_parts)
+            sr, counts, offs = bucket_records(records, pids, num_parts)
 
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
@@ -219,41 +238,49 @@ class ShuffleExchange:
             recv_rounds = []
             for r in range(num_rounds):
                 slots, _ = fill_round_slots(
-                    sr, sp, counts, offs, num_parts, capacity, r
-                )                                           # [P, C, W]
-                # group per destination device: [mesh, ppd, C, W]
-                slots = slots.reshape(ppd, mesh_size, capacity, record_words
-                                      ).transpose(1, 0, 2, 3)
+                    sr, counts, offs, num_parts, capacity, r
+                )                                           # [W, P, C]
+                # group per destination device: [mesh, ppd, W, C]
+                # (partition p = q*mesh + d lives on device d, local q)
+                slots = slots.reshape(record_words, ppd, mesh_size, capacity
+                                      ).transpose(2, 1, 0, 3)
                 recv = lax.all_to_all(
                     slots, ax, split_axis=0, concat_axis=0, tiled=True
-                )                                           # [mesh, ppd, C, W]
+                )                                           # [mesh, ppd, W, C]
                 recv_rounds.append(recv)
 
             # --- reduce side: concat rounds, compact ------------------
-            # data[s, q, r, c] = round r's c-th record from source s for
-            # local partition q. Group the output stream by local partition
-            # first, then source (a reduce task consumes ITS partition from
-            # every map output in map order), then rounds*capacity.
-            data = jnp.stack(recv_rounds, axis=2)   # [mesh, ppd, rounds, C, W]
-            stream = data.transpose(1, 0, 2, 3, 4).reshape(
-                ppd * mesh_size, num_rounds * capacity, record_words
+            # data[s, q, r, :, c] = round r's c-th record from source s
+            # for local partition q. Group the output stream by local
+            # partition first, then source (a reduce task consumes ITS
+            # partition from every map output in map order), then round.
+            # Each (q, s, r) chunk is prefix-valid with length
+            # clip(incoming[s, q] - r*capacity, 0, capacity).
+            data = jnp.stack(recv_rounds, axis=2)  # [mesh, ppd, rounds, W, C]
+            stream = data.transpose(3, 1, 0, 2, 4).reshape(
+                record_words,
+                ppd * mesh_size * num_rounds * capacity,
             )
-            valid = (
-                jnp.arange(num_rounds * capacity)[None, :]
-                < incoming.T.reshape(-1)[:, None]
+            # chunk lengths [ppd*mesh*rounds] in stream order (q, s, r)
+            inc = incoming.T.reshape(ppd * mesh_size, 1)    # [q*s, 1]
+            r_ix = jnp.arange(num_rounds, dtype=jnp.int32)[None, :]
+            chunk_len = jnp.clip(inc - r_ix * capacity, 0, capacity)
+            out, total = compact_segments(
+                stream, chunk_len.reshape(-1), out_capacity
             )
-            out, total = compact(
-                stream.reshape(-1, record_words), valid.reshape(-1),
-                out_capacity,
-            )
+            if sort_key_words:
+                from sparkrdma_tpu.kernels.sort import lexsort_cols
+
+                valid = jnp.arange(out_capacity) < total
+                out = lexsort_cols(out, sort_key_words, valid)
             return out, total[None], incoming[None]
 
         return jax.jit(
             shard_map(
                 local_step,
                 mesh=self.mesh,
-                in_specs=(P(ax),),
-                out_specs=(P(ax), P(ax), P(ax)),
+                in_specs=(P(None, ax),),
+                out_specs=(P(None, ax), P(ax), P(ax)),
             )
         )
 
@@ -264,18 +291,21 @@ class ShuffleExchange:
         plan: ShufflePlan,
         num_parts: Optional[int] = None,
         shuffle_id: int = -1,
+        sort_key_words: int = 0,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Run the planned exchange.
 
         Args:
-          records: global ``uint32[mesh*N_local, W]`` sharded over the
-            shuffle axis (rows grouped by source device).
+          records: columnar global ``uint32[W, mesh*N_local]`` sharded
+            over the record axis (``MeshRuntime.shard_records``), column
+            groups ordered by source device.
           partitioner: jit-safe ``records -> int32[n]`` destination
             partition ids; must match the one used in :meth:`plan`.
           plan: output of :meth:`plan`.
 
         Returns ``(out, totals, incoming)``:
-          - ``out``: ``uint32[mesh*out_capacity, W]`` — device d's rows are
+          - ``out``: columnar ``uint32[W, mesh*out_capacity]`` — device
+            d's columns are
             its compacted received records (zero-padded tail);
           - ``totals``: ``int32[mesh]`` — valid record count per device;
           - ``incoming``: ``int32[mesh, mesh*ppd... ]`` flattened per-source
@@ -291,13 +321,15 @@ class ShuffleExchange:
             )
         num_parts = plan_parts
         self._maybe_inject_fault(shuffle_id)
-        w = records.shape[-1]
+        w = records.shape[0]
         key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
-               w, getattr(partitioner, "cache_key", id(partitioner)))
+               w, sort_key_words,
+               getattr(partitioner, "cache_key", id(partitioner)))
         fn = self._exec_cache.get(key)
         if fn is None:
             fn = self._build_exec(num_parts, plan.capacity, plan.num_rounds,
-                                  plan.out_capacity, w, partitioner)
+                                  plan.out_capacity, w, partitioner,
+                                  sort_key_words)
             self._exec_cache[key] = fn
         return fn(records)
 

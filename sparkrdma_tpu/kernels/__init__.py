@@ -2,13 +2,21 @@
 
 These replace the reference's CPU-side data path — Spark's ExternalSorter on
 the map side and the decompress/deserialize/merge pipeline on the reduce
-side — with jnp/XLA ops (Pallas variants in :mod:`sparkrdma_tpu.kernels
-.pallas` for the hot paths), so shuffled bytes never leave HBM.
+side — with jnp/XLA ops, so shuffled bytes never leave HBM. The device data
+path is columnar (``uint32[W, N]``; see ``MeshRuntime.shard_records``);
+row-major helpers remain for host-scale callers.
 """
 
-from sparkrdma_tpu.kernels.bucketing import bucket_records, fill_round_slots
+from sparkrdma_tpu.kernels.aggregate import (
+    combine_by_key,
+    combine_by_key_cols,
+    count_by_key,
+)
+from sparkrdma_tpu.kernels.bucketing import (bucket_records, compact_segments,
+                                             fill_round_slots)
 from sparkrdma_tpu.kernels.sort import (
     compact,
+    lexsort_cols,
     lexsort_records,
     merge_sorted_runs,
 )
@@ -16,7 +24,12 @@ from sparkrdma_tpu.kernels.sort import (
 __all__ = [
     "bucket_records",
     "fill_round_slots",
+    "compact_segments",
     "compact",
+    "lexsort_cols",
     "lexsort_records",
     "merge_sorted_runs",
+    "combine_by_key",
+    "combine_by_key_cols",
+    "count_by_key",
 ]

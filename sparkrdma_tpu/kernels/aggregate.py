@@ -6,8 +6,10 @@ RdmaShuffleReader §read). TPU-native equivalent: after the exchange, sort
 the received records by key and segment-reduce runs of equal keys — fixed
 shapes, VPU-friendly, no hash tables.
 
-Payload words can be interpreted as uint32 or float32 (bitcast); reductions
-supported: sum (uint32 wraparound or float32), min, max.
+Core is columnar (``uint32[W, N]`` batches, matching the exchange data
+path); thin row-major wrappers remain for host-scale callers and tests.
+Payload words can be interpreted as uint32 or float32 (bitcast);
+reductions supported: sum (uint32 wraparound or float32), min, max.
 """
 
 from __future__ import annotations
@@ -17,13 +19,64 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from sparkrdma_tpu.kernels.sort import lexsort_records
+from sparkrdma_tpu.kernels.sort import lexsort_cols
 
 
-def _keys_equal_prev(sorted_keys: jax.Array) -> jax.Array:
-    """bool[N]: row i has the same key as row i-1 (row 0 -> False)."""
-    eq = jnp.all(sorted_keys[1:] == sorted_keys[:-1], axis=1)
-    return jnp.concatenate([jnp.zeros((1,), bool), eq])
+def combine_by_key_cols(
+    cols: jax.Array,
+    valid: jax.Array,
+    key_words: int,
+    op: str = "sum",
+    float_payload: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Reduce payloads of equal keys; return ``(combined, num_unique)``.
+
+    ``cols: uint32[W, N]`` with leading ``key_words`` key rows. Output
+    keeps shape ``[W, N]``: the first ``num_unique`` columns are unique
+    keys (sorted ascending) with reduced payloads; tail is zero padding.
+    """
+    w, n = cols.shape
+    srt = lexsort_cols(cols, key_words, valid)
+    nvalid = jnp.sum(valid).astype(jnp.int32)
+    in_valid = jnp.arange(n) < nvalid
+    keys = srt[:key_words]                       # [kw, N]
+    payload = srt[key_words:]                    # [W-kw, N]
+    if float_payload:
+        payload = jax.lax.bitcast_convert_type(payload, jnp.float32)
+
+    eq = jnp.all(keys[:, 1:] == keys[:, :-1], axis=0)
+    same = jnp.concatenate([jnp.zeros((1,), bool), eq]) & in_valid
+    # segment id per record: 0-based index of its unique key
+    seg = jnp.cumsum((~same & in_valid).astype(jnp.int32)) - 1
+    seg = jnp.where(in_valid, seg, n)  # padding -> out-of-range id
+    num_unique = jnp.where(nvalid > 0, seg[jnp.maximum(nvalid - 1, 0)] + 1, 0)
+
+    # segment ops over the record axis, payload words batched on axis 0
+    pT = payload.T                               # [N, W-kw]
+    if op == "sum":
+        red = jax.ops.segment_sum(pT, seg, num_segments=n)
+    elif op == "min":
+        red = jax.ops.segment_min(pT, seg, num_segments=n)
+    elif op == "max":
+        red = jax.ops.segment_max(pT, seg, num_segments=n)
+    else:
+        raise ValueError(f"unsupported op {op!r}")
+    red = red.T                                  # [W-kw, N]
+    if float_payload:
+        red = jax.lax.bitcast_convert_type(red, jnp.uint32)
+
+    # representative key per segment: the first record of each run
+    first_of_run = (~same) & in_valid
+    dst = jnp.where(first_of_run, seg, n)
+    seg_keys = (
+        jnp.zeros((n, key_words), jnp.uint32)
+        .at[dst]
+        .set(keys.T, mode="drop")
+    ).T
+    out = jnp.concatenate([seg_keys, red.astype(jnp.uint32)], axis=0)
+    live = (jnp.arange(n) < num_unique)[None, :]
+    out = out * live.astype(out.dtype)
+    return out, num_unique.astype(jnp.int32)
 
 
 def combine_by_key(
@@ -33,50 +86,10 @@ def combine_by_key(
     op: str = "sum",
     float_payload: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Reduce payloads of equal keys; return ``(combined, num_unique)``.
-
-    ``records: uint32[N, W]`` with leading ``key_words`` key columns.
-    Output keeps shape ``[N, W]``: first ``num_unique`` rows are unique keys
-    (sorted ascending) with reduced payloads; tail is zero padding.
-    """
-    n, w = records.shape
-    srt = lexsort_records(records, key_words, valid)
-    nvalid = jnp.sum(valid).astype(jnp.int32)
-    in_valid = jnp.arange(n) < nvalid
-    keys = srt[:, :key_words]
-    payload = srt[:, key_words:]
-    if float_payload:
-        payload = jax.lax.bitcast_convert_type(payload, jnp.float32)
-
-    same = _keys_equal_prev(keys) & in_valid
-    # segment id per row: 0-based index of its unique key
-    seg = jnp.cumsum((~same & in_valid).astype(jnp.int32)) - 1
-    # padding rows get an out-of-range id; segment ops drop them
-    seg = jnp.where(in_valid, seg, n)
-    num_unique = jnp.where(nvalid > 0, seg[jnp.maximum(nvalid - 1, 0)] + 1, 0)
-
-    if op == "sum":
-        red = jax.ops.segment_sum(payload, seg, num_segments=n)
-    elif op == "min":
-        red = jax.ops.segment_min(payload, seg, num_segments=n)
-    elif op == "max":
-        red = jax.ops.segment_max(payload, seg, num_segments=n)
-    else:
-        raise ValueError(f"unsupported op {op!r}")
-    if float_payload:
-        red = jax.lax.bitcast_convert_type(red, jnp.uint32)
-
-    # representative key per segment: the first row of each run
-    first_of_run = (~same) & in_valid
-    seg_keys = (
-        jnp.zeros((n, key_words), jnp.uint32)
-        .at[jnp.where(first_of_run, seg, n)]
-        .set(keys, mode="drop")
-    )
-    out = jnp.concatenate([seg_keys, red.astype(jnp.uint32)], axis=1)
-    live = (jnp.arange(n) < num_unique)[:, None]
-    out = out * live.astype(out.dtype)
-    return out, num_unique.astype(jnp.int32)
+    """Row-major wrapper: ``records uint32[N, W]`` -> ``([N, W], n)``."""
+    out, n = combine_by_key_cols(records.T, valid, key_words, op,
+                                 float_payload)
+    return out.T, n
 
 
 def count_by_key(records: jax.Array, valid: jax.Array,
@@ -88,4 +101,4 @@ def count_by_key(records: jax.Array, valid: jax.Array,
     return combine_by_key(with_ones, valid, key_words, op="sum")
 
 
-__all__ = ["combine_by_key", "count_by_key"]
+__all__ = ["combine_by_key", "combine_by_key_cols", "count_by_key"]

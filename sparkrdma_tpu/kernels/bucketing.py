@@ -1,4 +1,4 @@
-"""Map-side partition bucketing and slot packing.
+"""Map-side partition bucketing and slot packing (columnar).
 
 This is the map half of the data path. In the reference, map output is
 produced by stock Spark (``SortShuffleWriter`` -> ``ExternalSorter``: sort
@@ -7,20 +7,27 @@ per-partition offsets), and ``RdmaMappedFile`` then exposes each partition
 as an ``(addr, len)`` range for one-sided READ (src/main/java/org/apache/
 spark/shuffle/rdma/RdmaMappedFile.java §getRdmaBlockLocation).
 
-Here the same two steps happen in HBM:
+Here the same two steps happen in HBM, on COLUMNAR record batches
+``uint32[W, N]`` (one contiguous vector per record word — see
+``MeshRuntime.shard_records`` for the layout rationale):
 
-- :func:`bucket_records` = the ExternalSorter: a stable sort of the local
-  records by destination partition, yielding the "data file" (sorted record
-  array) and the "index file" (per-partition counts/offsets) in one pass.
+- :func:`bucket_records` = the ExternalSorter: one variadic ``lax.sort``
+  keyed on destination partition, every word column riding along as a
+  value — the "data file" (bucketed columns) and "index file"
+  (counts/offsets) in one fused pass.
 - :func:`fill_round_slots` = RdmaMappedFile + the fetcher's block
-  aggregation: carve the bucketed records into fixed-capacity per-destination
-  slots for exchange round ``r``. Fixed capacity is what turns SparkRDMA's
-  exact-byte-range READs into XLA-legal static shapes; partitions larger
-  than one slot stream across multiple rounds (the ``maxAggBlock`` /
-  chunked-READ analogue, SURVEY.md §5 long-context row).
+  aggregation: carve the bucketed columns into fixed-capacity
+  per-destination windows for exchange round ``r``. Each window is a
+  contiguous ``dynamic_slice`` — literally an RDMA READ of byte range
+  ``(addr=offsets[p] + r*cap, len=cap)``. Fixed capacity is what turns
+  SparkRDMA's exact-byte-range READs into XLA-legal static shapes;
+  partitions larger than one slot stream across rounds (the
+  ``maxAggBlock`` / chunked-READ analogue, SURVEY.md §5 long-context row).
+- :func:`compact_segments` is the reduce-side inverse: concatenate the
+  valid prefixes of received fixed-stride segments by chained contiguous
+  copies (ascending order repairs each zero tail).
 
-All functions are jit-safe per-device functions (no collectives) operating
-on ``records: uint32[N, W]`` with ``part_ids: int32[N]``.
+All functions are jit-safe per-device functions (no collectives).
 """
 
 from __future__ import annotations
@@ -29,34 +36,35 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def bucket_records(
     records: jax.Array, part_ids: jax.Array, num_parts: int
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Stable-sort local records by destination partition.
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Stable-sort a columnar batch ``[W, N]`` by destination partition.
 
-    Returns ``(sorted_records, sorted_part_ids, counts, offsets)`` where
+    Returns ``(bucketed [W, N], counts [P], offsets [P])`` where
     ``counts[p]`` is the number of local records bound for partition ``p``
-    and ``offsets[p]`` is the start of partition ``p``'s run in
-    ``sorted_records`` — the exact content of Spark's shuffle index file.
+    and ``offsets[p]`` the start of its run — the exact content of Spark's
+    shuffle index file. One fused variadic sort: pid is the key, record
+    word columns ride along as values (stable, preserving arrival order
+    within a partition).
     """
-    n = records.shape[0]
+    w, n = records.shape
     part_ids = part_ids.astype(jnp.int32)
-    order = jnp.argsort(part_ids, stable=True)
-    sorted_records = jnp.take(records, order, axis=0)
-    sorted_pids = jnp.take(part_ids, order)
+    out = lax.sort((part_ids,) + tuple(records[i] for i in range(w)),
+                   num_keys=1, is_stable=True)
+    bucketed = jnp.stack(out[1:])
     counts = jnp.bincount(part_ids, length=num_parts).astype(jnp.int32)
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
     )
-    del n
-    return sorted_records, sorted_pids, counts, offsets
+    return bucketed, counts, offsets
 
 
 def fill_round_slots(
-    sorted_records: jax.Array,
-    sorted_pids: jax.Array,
+    bucketed: jax.Array,
     counts: jax.Array,
     offsets: jax.Array,
     num_parts: int,
@@ -66,28 +74,64 @@ def fill_round_slots(
     """Pack round ``round_idx``'s window of each bucket into send slots.
 
     Slot ``p`` receives records ``[r*capacity, (r+1)*capacity)`` of bucket
-    ``p`` (record-rank window, like a chunked RDMA READ at byte offset
-    ``r*maxAggBlock``). Returns ``(slots: uint32[num_parts, capacity, W],
-    send_counts: int32[num_parts])``; slot tails beyond ``send_counts[p]``
-    are zero-filled padding.
+    ``p``. Returns ``(slots: uint32[W, num_parts, capacity], send_counts:
+    int32[num_parts])``; tails beyond ``send_counts[p]`` are zero padding.
+
+    ``num_parts`` contiguous window reads per column at HBM bandwidth —
+    a per-row gather of narrow records would use W of the VPU's 128 lanes.
     """
-    n, w = sorted_records.shape
+    w, n = bucketed.shape
     round_idx = jnp.asarray(round_idx, jnp.int32)
-    # rank of each record within its destination bucket
-    pos_in_bucket = jnp.arange(n, dtype=jnp.int32) - jnp.take(offsets, sorted_pids)
-    rel = pos_in_bucket - round_idx * capacity
-    valid = (rel >= 0) & (rel < capacity)
-    # flat scatter destination; invalid records land in a dump row
-    flat_dest = jnp.where(valid, sorted_pids * capacity + rel,
-                          num_parts * capacity)
-    slots = (
-        jnp.zeros((num_parts * capacity + 1, w), dtype=sorted_records.dtype)
-        .at[flat_dest]
-        .set(sorted_records, mode="drop")[: num_parts * capacity]
-        .reshape(num_parts, capacity, w)
-    )
+    c = jnp.arange(capacity, dtype=jnp.int32)
     send_counts = jnp.clip(counts - round_idx * capacity, 0, capacity)
+    valid = (c[None, :] < send_counts[:, None])           # [P, C]
+    pad = jnp.zeros((w, capacity), bucketed.dtype)
+    # pad so every window is in-bounds (dynamic_slice clamps otherwise,
+    # which would silently shift a window into the previous bucket)
+    padded = jnp.concatenate([bucketed, pad], axis=1)     # [W, N+C]
+    windows = []
+    for p in range(num_parts):  # static unroll: P contiguous copies
+        start = offsets[p] + round_idx * capacity
+        windows.append(lax.dynamic_slice(padded, (0, start), (w, capacity)))
+    slots = jnp.stack(windows, axis=1)                    # [W, P, C]
+    slots = slots * valid[None].astype(slots.dtype)
     return slots, send_counts.astype(jnp.int32)
 
 
-__all__ = ["bucket_records", "fill_round_slots"]
+def compact_segments(
+    stream: jax.Array, seg_counts: jax.Array, out_capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Concatenate the valid prefixes of fixed-stride segments.
+
+    ``stream: [W, S*C]`` where segment ``s`` occupies columns ``[s*C, s*C
+    + seg_counts[s])`` (prefix-valid, zero tail) — the layout the exchange
+    produces per (local partition, source, round). Validity is
+    per-segment-prefix, so the compaction is S chained contiguous
+    ``dynamic_update_slice`` copies written in ascending segment order:
+    each segment's zero tail is overwritten by the next segment's data,
+    and the final tail is masked. No sort, no gather.
+
+    Returns ``(packed: [W, out_capacity], total)``; ``total`` may exceed
+    ``out_capacity`` (overflow is the caller's contract, as in
+    :func:`~sparkrdma_tpu.kernels.sort.compact`).
+    """
+    w, sc = stream.shape
+    s = seg_counts.shape[0]
+    c = sc // s
+    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                           jnp.cumsum(seg_counts).astype(jnp.int32)])
+    total = cum[-1]
+    # +C headroom so the last write never clamps (clamping would shift the
+    # window backward over valid data)
+    out = jnp.zeros((w, out_capacity + c), stream.dtype)
+    for i in range(s):  # ascending: later segments repair earlier tails
+        seg = lax.dynamic_slice(stream, (0, i * c), (w, c))
+        dst = jnp.minimum(cum[i], out_capacity)
+        out = lax.dynamic_update_slice(out, seg, (0, dst))
+    packed = out[:, :out_capacity]
+    valid = jnp.arange(out_capacity, dtype=jnp.int32) < total
+    packed = packed * valid[None, :].astype(packed.dtype)
+    return packed, total
+
+
+__all__ = ["bucket_records", "fill_round_slots", "compact_segments"]

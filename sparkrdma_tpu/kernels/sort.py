@@ -22,6 +22,26 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def _sort_rows(records: jax.Array, num_keys: int,
+               lead_keys: Tuple[jax.Array, ...] = ()) -> jax.Array:
+    """Sort rows of ``records: [N, W]`` by ``lead_keys`` then the leading
+    ``num_keys`` columns, lexicographically, via ONE fused ``lax.sort``.
+
+    A single variadic sort (XLA's native lexicographic comparator over
+    ``num_keys`` operands) replaces the chained per-word stable
+    argsort+gather passes — one sort network instead of K+1, and the
+    payload columns ride along as values instead of being gathered
+    afterwards. Stable, so equal keys keep their arrival order.
+    """
+    n, w = records.shape
+    cols = tuple(records[:, i] for i in range(w))
+    operands = lead_keys + cols
+    out = lax.sort(operands, num_keys=len(lead_keys) + num_keys,
+                   is_stable=True)
+    return jnp.stack(out[len(lead_keys):], axis=1)
 
 
 def compact(
@@ -30,7 +50,7 @@ def compact(
     """Pack valid records to the front; return ``(packed, count)``.
 
     ``records: [N, W]``, ``valid: bool[N]``. Output has static shape
-    ``[out_capacity, W]`` (zero-padded). A stable argsort on the inverted
+    ``[out_capacity, W]`` (zero-padded). A stable sort on the inverted
     mask is XLA's native way to partition without dynamic shapes.
 
     ``count`` is the TRUE number of valid records, which may exceed
@@ -41,8 +61,8 @@ def compact(
     resizing silently.
     """
     n = records.shape[0]
-    order = jnp.argsort(~valid, stable=True)
-    packed = jnp.take(records, order, axis=0)
+    packed = _sort_rows(records, 0,
+                        lead_keys=((~valid).astype(jnp.uint8),))
     if out_capacity <= n:
         packed = packed[:out_capacity]
     else:
@@ -55,34 +75,33 @@ def compact(
     return packed, count
 
 
-def _composite_sort_order(keys: jax.Array, valid=None) -> jax.Array:
-    """Stable order sorting rows of ``keys: uint32[N, K]`` lexicographically.
-
-    Least-significant-word stable sorts first (LSD), most-significant last —
-    each pass being stable makes the composite order lexicographic. Invalid
-    rows (padding) sort to the end.
-    """
-    n, k = keys.shape
-    order = jnp.arange(n, dtype=jnp.int32)
-    for word in range(k - 1, -1, -1):
-        order = jnp.take(order, jnp.argsort(jnp.take(keys[:, word], order),
-                                            stable=True))
-    if valid is not None:
-        order = jnp.take(order, jnp.argsort(~jnp.take(valid, order),
-                                            stable=True))
-    return order
-
-
 def lexsort_records(
     records: jax.Array, key_words: int, valid: jax.Array | None = None
 ) -> jax.Array:
     """Sort ``records: uint32[N, W]`` by their leading ``key_words`` words.
 
     Padding rows (``valid == False``) are moved to the tail regardless of
-    key value. Stable within equal keys.
+    key value. Stable within equal keys. Row-major convenience wrapper
+    (host-scale data, tests); the device data path uses
+    :func:`lexsort_cols`.
     """
-    order = _composite_sort_order(records[:, :key_words], valid)
-    return jnp.take(records, order, axis=0)
+    lead = () if valid is None else ((~valid).astype(jnp.uint8),)
+    return _sort_rows(records, key_words, lead_keys=lead)
+
+
+def lexsort_cols(
+    cols: jax.Array, key_words: int, valid: jax.Array | None = None
+) -> jax.Array:
+    """Sort a columnar batch ``uint32[W, N]`` by its leading ``key_words``
+    word rows — one fused variadic ``lax.sort`` over contiguous columns.
+
+    Padding (``valid == False``) sorts to the tail. Stable.
+    """
+    w, n = cols.shape
+    lead = () if valid is None else ((~valid).astype(jnp.uint8),)
+    out = lax.sort(lead + tuple(cols[i] for i in range(w)),
+                   num_keys=len(lead) + key_words, is_stable=True)
+    return jnp.stack(out[len(lead):])
 
 
 def merge_sorted_runs(
@@ -105,4 +124,4 @@ def merge_sorted_runs(
     return merged, total
 
 
-__all__ = ["compact", "lexsort_records", "merge_sorted_runs"]
+__all__ = ["compact", "lexsort_records", "lexsort_cols", "merge_sorted_runs"]

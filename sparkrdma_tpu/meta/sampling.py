@@ -35,10 +35,13 @@ def make_sampler(mesh: Mesh, axis_name: str, key_words: int,
     """
 
     def local_sample(records):
-        n = records.shape[0]
+        # records: columnar [W, n_local]
+        n = records.shape[1]
         stride = max(1, n // samples_per_device)
         idx = (jnp.arange(samples_per_device) * stride) % jnp.maximum(n, 1)
-        sample = jnp.take(records[:, :key_words], idx, axis=0)
+        sample = jnp.stack(
+            [jnp.take(records[w], idx) for w in range(key_words)], axis=1
+        )  # [samples, key_words] — tiny, row-major is fine
         # all_gather so every device can compute identical splitters
         gathered = jax.lax.all_gather(sample, axis_name, tiled=True)
         return gathered
@@ -46,7 +49,7 @@ def make_sampler(mesh: Mesh, axis_name: str, key_words: int,
     fn = shard_map(
         local_sample,
         mesh=mesh,
-        in_specs=(P(axis_name),),
+        in_specs=(P(None, axis_name),),
         out_specs=P(),  # replicated by the all_gather
         check_vma=False,  # VMA can't statically infer all_gather replication
     )

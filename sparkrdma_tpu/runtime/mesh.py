@@ -125,6 +125,28 @@ class MeshRuntime:
         """Place host data with rows split across the shuffle axis."""
         return jax.device_put(x, self.sharding())
 
+    def shard_records(self, rows) -> jax.Array:
+        """Host row-major records ``[N, W]`` -> device record batch.
+
+        Device-side record batches are COLUMNAR: ``u32[W, N]`` sharded
+        over ``N`` (structure-of-arrays). TPU tiles pad the minor
+        dimension to 128 lanes, so a row-major ``[N, 4]`` array can cost
+        32x its logical size and row-gathers use 4 of 128 lanes; storing
+        each record word as a contiguous ``[N]`` vector makes every
+        kernel a full-lane operation. Hosts still speak rows (the
+        reference's record framing); this is the transpose boundary.
+        """
+        import numpy as np
+
+        rows = np.ascontiguousarray(rows)
+        return jax.device_put(rows.T, self.sharding(None, self.axis_name))
+
+    def host_rows(self, cols) -> "np.ndarray":
+        """Device columnar batch ``[W, N]`` -> host rows ``[N, W]``."""
+        import numpy as np
+
+        return np.ascontiguousarray(np.asarray(cols).T)
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------

@@ -93,4 +93,23 @@ class Timer:
         self.elapsed = time.perf_counter() - self.t0
 
 
-__all__ = ["ExchangeRecord", "ShuffleReadStats", "Timer"]
+def barrier(*arrays) -> None:
+    """Hard execution barrier for timing: wait AND materialize one element.
+
+    ``jax.block_until_ready`` alone is not a reliable barrier on every
+    backend (tunneled/experimental platforms can return before the device
+    finishes); transferring a single element of each array forces the
+    producing executable to complete on any backend, at the cost of a
+    few bytes of D2H. Use at the edges of timed regions.
+    """
+    import jax
+
+    for a in arrays:
+        jax.block_until_ready(a)
+        try:
+            np.asarray(a[(0,) * a.ndim])
+        except Exception:  # non-indexable / non-addressable: block must do
+            pass
+
+
+__all__ = ["ExchangeRecord", "ShuffleReadStats", "Timer", "barrier"]

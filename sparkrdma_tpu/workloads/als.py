@@ -42,6 +42,7 @@ from sparkrdma_tpu.utils.compat import shard_map
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
+from sparkrdma_tpu.utils.stats import barrier
 
 
 @dataclasses.dataclass
@@ -103,17 +104,18 @@ def _make_build_fn(runtime: MeshRuntime, k: int, w: int):
 
     def build(factors_local, base_local, srcidx_local, rating_local,
               mask_local):
+        # base_local: columnar [w, E]
         f = jnp.take(factors_local, srcidx_local[:, 0], axis=0)  # [E, k]
         f = jnp.where(mask_local, f, 0.0)
         r = jnp.where(mask_local[:, 0], rating_local[:, 0], 0.0)
         payload = jax.lax.bitcast_convert_type(
             jnp.concatenate([r[:, None], f], axis=1), jnp.uint32)
-        return jnp.concatenate([base_local[:, :2], payload], axis=1)
+        return jnp.concatenate([base_local[:2], payload.T], axis=0)
 
     return jax.jit(shard_map(
         build, mesh=runtime.mesh,
-        in_specs=(P(ax), P(ax), P(ax), P(ax), P(ax)),
-        out_specs=P(ax),
+        in_specs=(P(ax), P(None, ax), P(ax), P(ax), P(ax)),
+        out_specs=P(None, ax),
     ))
 
 
@@ -127,11 +129,12 @@ def _make_update_fn(runtime: MeshRuntime, k: int, per: int, out_cap: int,
     ax = runtime.axis_name
 
     def update(received, total):
+        # received: columnar [w, out_cap]
         valid = jnp.arange(out_cap) < total[0]
-        dst = received[:, 1].astype(jnp.int32)
-        fr = jax.lax.bitcast_convert_type(received[:, 2:3 + k], jnp.float32)
-        r = jnp.where(valid, fr[:, 0], 0.0)
-        f = jnp.where(valid[:, None], fr[:, 1:], 0.0)          # [cap, k]
+        dst = received[1].astype(jnp.int32)
+        fr = jax.lax.bitcast_convert_type(received[2:3 + k], jnp.float32)
+        r = jnp.where(valid, fr[0], 0.0)
+        f = jnp.where(valid[:, None], fr[1:].T, 0.0)           # [cap, k]
         idx = jnp.where(valid, dst // mesh, per)
         outer = f[:, :, None] * f[:, None, :]                   # [cap, k, k]
         A = jnp.zeros((per, k, k), jnp.float32).at[idx].add(
@@ -143,7 +146,7 @@ def _make_update_fn(runtime: MeshRuntime, k: int, per: int, out_cap: int,
 
     return jax.jit(shard_map(
         update, mesh=runtime.mesh,
-        in_specs=(P(ax), P(ax)),
+        in_specs=(P(None, ax), P(ax)),
         out_specs=P(ax),
     ))
 
@@ -186,7 +189,7 @@ def run_als(
         base[:, 1] = tab[:, :, dst_col].reshape(-1).astype(np.uint32)
         srcidx = (tab[:, :, src_col].reshape(-1).astype(np.int64)
                   // mesh).astype(np.int32)
-        return (runtime.shard_rows(base),
+        return (runtime.shard_records(base),    # columnar [w, mesh*e]
                 runtime.shard_rows(srcidx[:, None]),
                 runtime.shard_rows(
                     tab[:, :, 2].reshape(-1, 1).astype(np.float32)),
@@ -221,7 +224,8 @@ def run_als(
         rec = build_fn(U, ibase, isrc, irate, imask_g)
         out, totals, _ = ex.exchange(rec, part, iplan, mesh)
         # Stage barrier per half-iteration pair (see pagerank.py note).
-        V = jax.block_until_ready(item_update(out, totals))
+        V = item_update(out, totals)
+        barrier(V)
     total_s = time.perf_counter() - t0
 
     u_np = _from_owner_layout(np.asarray(U), mesh, num_users)

@@ -28,6 +28,7 @@ from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+from sparkrdma_tpu.utils.stats import barrier
 
 
 @dataclasses.dataclass
@@ -41,16 +42,17 @@ class JoinResult:
     verified: Optional[bool] = None
 
 
-def _local_join(rows_a, total_a, rows_b, total_b, cap_a, cap_b):
+def _local_join(cols_a, total_a, cols_b, total_b, cap_a, cap_b):
     """Per-device sort-merge join -> (count, sum of payload products).
 
-    Sorts both sides by the lo key word, then for each A row looks up B's
-    per-key aggregate via two searchsorteds — no pair materialization.
-    Payloads are the word right after the 2 key words, treated as uint32
-    values accumulated in float64-free fashion (float32 sums).
+    Inputs are columnar ``[W, cap]`` batches. Sorts both sides by the lo
+    key word (one fused variadic sort per side, payload riding along),
+    then for each A record looks up B's per-key aggregate via two
+    searchsorteds — no pair materialization. Payloads are the word right
+    after the 2 key words, accumulated as float32 sums.
     """
-    ka = rows_a[:, 1]
-    kb = rows_b[:, 1]
+    ka = cols_a[1]
+    kb = cols_b[1]
     va = jnp.arange(cap_a) < total_a[0]
     vb = jnp.arange(cap_b) < total_b[0]
 
@@ -59,12 +61,10 @@ def _local_join(rows_a, total_a, rows_b, total_b, cap_a, cap_b):
     # or searchsorted ranges would sweep padding rows in
     ka = jnp.where(va, ka, jnp.uint32(0xFFFFFFFF))
     kb = jnp.where(vb, kb, jnp.uint32(0xFFFFFFFF))
-    oa = jnp.argsort(ka, stable=True)
-    ob = jnp.argsort(kb, stable=True)
-    sa, pa = jnp.take(ka, oa), jnp.take(rows_a[:, 2], oa)
-    sb, pb = jnp.take(kb, ob), jnp.take(rows_b[:, 2], ob)
-    va_s = jnp.take(va, oa)
-    vb_s = jnp.take(vb, ob)
+    sa, pa, va_s = jax.lax.sort((ka, cols_a[2], va), num_keys=1,
+                                is_stable=True)
+    sb, pb, vb_s = jax.lax.sort((kb, cols_b[2], vb), num_keys=1,
+                                is_stable=True)
 
     # B per-key prefix sums for O(log n) range aggregation
     pb_f = pb.astype(jnp.float32) * vb_s
@@ -120,12 +120,12 @@ def run_hash_join(
     outs = []
     for sid, x in zip(shuffle_ids, (xa, xb)):
         handle = manager.register_shuffle(sid, mesh, part)
-        writer = manager.get_writer(handle).write(rt.shard_rows(x))
+        writer = manager.get_writer(handle).write(rt.shard_records(x))
         writer.stop(True)
         out, totals = manager.get_reader(handle).read()
         outs.append((out, totals, writer.plan.out_capacity))
         manager.unregister_shuffle(sid)
-    jax.block_until_ready(outs[-1][0])
+    barrier(outs[-1][0])
     shuffle_s = time.perf_counter() - t0
 
     (oa, ta, ca), (ob, tb, cb) = outs
@@ -141,7 +141,7 @@ def run_hash_join(
 
         joined = jax.jit(shard_map(
             local, mesh=rt.mesh,
-            in_specs=(P(ax), P(ax), P(ax), P(ax)),
+            in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
             out_specs=(P(ax), P(ax)),
         ))
         cache[cache_key] = joined

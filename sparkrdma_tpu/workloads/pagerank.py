@@ -32,8 +32,9 @@ from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
-from sparkrdma_tpu.kernels.aggregate import combine_by_key
+from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
+from sparkrdma_tpu.utils.stats import barrier
 
 
 @dataclasses.dataclass
@@ -99,7 +100,7 @@ def run_pagerank(
     # static record keys: [hi=0, lo=dst]; payload word 2 = rank contribution
     base = np.zeros((mesh * epad, w), dtype=np.uint32)
     base[:, 1] = etab[:, :, 1].reshape(-1).astype(np.uint32)
-    base_global = runtime.shard_rows(base)
+    base_global = runtime.shard_records(base)   # columnar [w, mesh*epad]
 
     # plan once on the static keys (counts depend only on dst)
     # padding rows go to partition dst=0's owner; they carry zero payload
@@ -125,19 +126,21 @@ def run_pagerank(
     def build_records(ranks_local, base_local, srcidx_local, emask_local,
                       outdeg_local):
         # contribution = rank[src]/outdeg[src] for local edges
+        # base_local: columnar [w, epad]
         r = jnp.take(ranks_local[:, 0], srcidx_local[:, 0], axis=0)
         dg = jnp.take(outdeg_local[:, 0], srcidx_local[:, 0], axis=0)
         contrib = jnp.where(emask_local[:, 0], r / dg, 0.0)
         payload = jax.lax.bitcast_convert_type(contrib, jnp.uint32)
-        return base_local.at[:, 2].set(payload)
+        return base_local.at[2].set(payload)
 
     def update_ranks(received, total, outdeg_local):
         # combine contributions by dst key, scatter into the owner slice
+        # received: columnar [w, out_cap]
         valid = jnp.arange(out_cap) < total[0]
-        combined, nuniq = combine_by_key(received, valid, 2, op="sum",
-                                         float_payload=True)
-        dst = combined[:, 1].astype(jnp.int32)
-        sums = jax.lax.bitcast_convert_type(combined[:, 2], jnp.float32)
+        combined, nuniq = combine_by_key_cols(received, valid, 2, op="sum",
+                                              float_payload=True)
+        dst = combined[1].astype(jnp.int32)
+        sums = jax.lax.bitcast_convert_type(combined[2], jnp.float32)
         live = jnp.arange(out_cap) < nuniq
         idx = jnp.where(live, dst // mesh, vper)
         acc = jnp.zeros((vper,), jnp.float32).at[idx].add(
@@ -152,12 +155,12 @@ def run_pagerank(
 
     build_fn = jax.jit(shard_map(
         build_records, mesh=runtime.mesh,
-        in_specs=(P(ax), P(ax), P(ax), P(ax), P(ax)),
-        out_specs=P(ax),
+        in_specs=(P(ax), P(None, ax), P(ax), P(ax), P(ax)),
+        out_specs=P(None, ax),
     ))
     update_fn = jax.jit(shard_map(
         update_ranks, mesh=runtime.mesh,
-        in_specs=(P(ax), P(ax), P(ax)),
+        in_specs=(P(None, ax), P(ax), P(ax)),
         out_specs=P(ax),
     ))
 
@@ -171,8 +174,9 @@ def run_pagerank(
         # Per-iteration barrier: each shuffle iteration is a Spark stage
         # boundary (BSP). Also keeps the async dispatch queue shallow —
         # on forced-host CPU meshes, piling up collective programs can
-        # starve XLA's single-core rendezvous scheduler.
-        ranks = jax.block_until_ready(ranks)
+        # starve XLA's single-core rendezvous scheduler — and makes the
+        # timing honest on backends where block_until_ready is unreliable.
+        barrier(ranks)
     total_s = time.perf_counter() - t0
 
     # owner layout [mesh*vper] -> dense [v]

@@ -17,6 +17,7 @@ import numpy as np
 
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+from sparkrdma_tpu.utils.stats import barrier
 
 
 @dataclasses.dataclass
@@ -38,13 +39,13 @@ class RepartitionResult:
 
 def generate_records(manager: ShuffleManager, records_per_device: int,
                      seed: int = 0) -> jax.Array:
-    """Random records, sharded over the mesh (the map-stage input)."""
+    """Random records as a columnar sharded batch (the map-stage input)."""
     mesh = manager.runtime.num_partitions
     w = manager.conf.record_words
     rng = np.random.default_rng(seed)
     x = rng.integers(0, 2**32, size=(mesh * records_per_device, w),
                      dtype=np.uint32)
-    return manager.runtime.shard_rows(x)
+    return manager.runtime.shard_records(x)
 
 
 def run_repartition(
@@ -73,15 +74,15 @@ def run_repartition(
             jax.block_until_ready(reader.read(record_stats=False)[0])
         t0 = time.perf_counter()
         out, totals = reader.read()
-        jax.block_until_ready(out)
+        barrier(out)
         exchange_s = time.perf_counter() - t0
 
         verified = True
         if verify:
-            verified = int(np.asarray(totals).sum()) == records.shape[0]
+            verified = int(np.asarray(totals).sum()) == records.shape[1]
         return RepartitionResult(
-            records=records.shape[0],
-            record_bytes=records.shape[1] * 4,
+            records=records.shape[1],
+            record_bytes=records.shape[0] * 4,
             plan_s=plan_s,
             exchange_s=exchange_s,
             verified=verified,

@@ -31,6 +31,7 @@ import numpy as np
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import range_partitioner
 from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+from sparkrdma_tpu.utils.stats import barrier
 
 
 @dataclasses.dataclass
@@ -55,14 +56,17 @@ def validate_global_sort(
     out: np.ndarray, totals: np.ndarray, x_input: np.ndarray,
     key_words: int, out_capacity: int,
 ) -> bool:
-    """Sorted + permutation-of-input check (host-side, test-sized data)."""
+    """Sorted + permutation-of-input check (host-side, test-sized data).
+
+    ``out`` is the columnar read result ``[W, mesh*out_capacity]``;
+    ``x_input`` is host rows ``[N, W]``.
+    """
     mesh = totals.shape[0]
-    rows = out.reshape(mesh, out_capacity, -1)
     prev_max = None
     collected = []
     for d in range(mesh):
         k = int(totals[d])
-        dev = rows[d, :k]
+        dev = out[:, d * out_capacity:d * out_capacity + k].T  # rows [k, W]
         collected.append(dev)
         if k == 0:
             continue
@@ -103,10 +107,10 @@ def run_terasort(
         x = rng.integers(0, 2**32,
                          size=(mesh * records_per_device,
                                manager.conf.record_words), dtype=np.uint32)
-        records = rt.shard_rows(x)
+        records = rt.shard_records(x)
     else:
-        records = input_records
-        x = np.asarray(records)
+        records = input_records          # columnar [W, N]
+        x = rt.host_rows(records)
 
     # 1-2: sample on-fabric, splitters everywhere
     t0 = time.perf_counter()
@@ -128,7 +132,7 @@ def run_terasort(
             jax.block_until_ready(reader.read(record_stats=False)[0])
         t0 = time.perf_counter()
         out, totals = reader.read()
-        jax.block_until_ready(out)
+        barrier(out)
         sort_exchange_s = time.perf_counter() - t0
 
         verified = True

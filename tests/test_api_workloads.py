@@ -26,7 +26,7 @@ def test_spi_lifecycle(manager, rng):
     with pytest.raises(ValueError):
         manager.register_shuffle(10, 8, part)  # duplicate id
     x = rng.integers(1, 2**32, size=(8 * 16, 4), dtype=np.uint32)
-    writer = manager.get_writer(handle).write(manager.runtime.shard_rows(x))
+    writer = manager.get_writer(handle).write(manager.runtime.shard_records(x))
     plan = writer.stop(True)
     assert plan.total_records == x.shape[0]
     meta = manager._registry.get(10)
@@ -50,7 +50,7 @@ def test_reader_without_map_output_raises(manager):
 def test_writer_double_write_rejected(manager, rng):
     handle = manager.register_shuffle(12, 8, modulo_partitioner(8))
     try:
-        x = manager.runtime.shard_rows(
+        x = manager.runtime.shard_records(
             rng.integers(1, 2**32, size=(8 * 8, 4), dtype=np.uint32))
         w = manager.get_writer(handle).write(x)
         with pytest.raises(RuntimeError):
@@ -62,7 +62,7 @@ def test_writer_double_write_rejected(manager, rng):
 def test_writer_stop_failure_publishes_nothing(manager, rng):
     handle = manager.register_shuffle(13, 8, modulo_partitioner(8))
     try:
-        x = manager.runtime.shard_rows(
+        x = manager.runtime.shard_records(
             rng.integers(1, 2**32, size=(8 * 8, 4), dtype=np.uint32))
         w = manager.get_writer(handle).write(x)
         assert w.stop(False) is None
@@ -77,7 +77,7 @@ def test_read_partition_contents(manager, rng):
     handle = manager.register_shuffle(14, 8, part)
     try:
         x = rng.integers(1, 2**32, size=(8 * 32, 4), dtype=np.uint32)
-        manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop()
+        manager.get_writer(handle).write(manager.runtime.shard_records(x)).stop()
         got = manager.get_reader(handle).read_partition(3)
         ref = x[x[:, 0] % 8 == 3]
         # same multiset (read_partition groups by source in source order)
@@ -113,7 +113,7 @@ def test_terasort_skewed_input(manager, rng):
     x = rng.integers(0, 2**32, size=(mesh * 100, 4), dtype=np.uint32)
     x[: mesh * 60, 0] = 7  # 60% of keys share one msw
     x[: mesh * 60, 1] = rng.integers(0, 4, size=mesh * 60, dtype=np.uint32)
-    rec = manager.runtime.shard_rows(x)
+    rec = manager.runtime.shard_records(x)
     res, out, totals = run_terasort(manager, 0, warmup=False, shuffle_id=23,
                                     input_records=rec)
     assert res.verified
@@ -132,7 +132,7 @@ def test_validate_global_sort_rejects_bad():
     out = np.zeros((2 * 4, 4), dtype=np.uint32)
     out[0] = [2, 0, 0, 0]
     out[4] = [1, 0, 0, 0]  # device 1 starts below device 0's max
-    assert not validate_global_sort(out, np.array([1, 1]), x, 2, 4)
+    assert not validate_global_sort(out.T, np.array([1, 1]), x, 2, 4)
 
 
 def test_reader_partition_range_filter(manager, rng):
@@ -143,7 +143,7 @@ def test_reader_partition_range_filter(manager, rng):
     x = np.zeros((8 * 24, 4), dtype=np.uint32)
     x[:, 1] = rng.integers(0, 16, size=8 * 24).astype(np.uint32)
     x[:, 2] = rng.integers(0, 2**32, size=8 * 24, dtype=np.uint32)
-    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+    manager.get_writer(handle).write(manager.runtime.shard_records(x)).stop(True)
 
     full_out, full_totals = manager.get_reader(handle).read()
     assert int(np.asarray(full_totals).sum()) == x.shape[0]
@@ -155,10 +155,11 @@ def test_reader_partition_range_filter(manager, rng):
     assert int(np.asarray(totals).sum()) == expect
     # every kept record's key is inside the range
     plan = manager._writers[40].plan
-    rows = np.asarray(out).reshape(8, plan.out_capacity, -1)
+    cap = plan.out_capacity
+    cols = np.asarray(out)                       # columnar [W, 8*cap]
     t = np.asarray(totals)
     for d in range(8):
-        keys = rows[d, :int(t[d]), 1]
+        keys = cols[1, d * cap:d * cap + int(t[d])]
         assert np.all((keys >= start) & (keys < end))
     # read_partition agrees with the filtered layout
     reader = manager.get_reader(handle, start_partition=start,
@@ -178,7 +179,7 @@ def test_exchange_num_parts_must_match_plan(manager, rng):
     part = modulo_partitioner(16, key_word=1)
     x = np.zeros((8 * 8, 4), dtype=np.uint32)
     x[:, 1] = rng.integers(0, 16, size=8 * 8).astype(np.uint32)
-    records = manager.runtime.shard_rows(x)
+    records = manager.runtime.shard_records(x)
     plan = ex.plan(records, part, num_parts=16)
     out, totals, _ = ex.exchange(records, part, plan)  # derives 16
     assert int(np.asarray(totals).sum()) == x.shape[0]
@@ -194,7 +195,7 @@ def test_read_partition_with_key_ordering(manager, rng):
     handle = manager.register_shuffle(41, 16, part)
     x = np.zeros((8 * 24, 4), dtype=np.uint32)
     x[:, 1] = rng.integers(0, 64, size=8 * 24).astype(np.uint32)
-    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+    manager.get_writer(handle).write(manager.runtime.shard_records(x)).stop(True)
     reader = manager.get_reader(handle, key_ordering=True)
     p11 = reader.read_partition(11)
     assert p11.shape[0] == int(np.sum(x[:, 1] % 16 == 11))

@@ -2,57 +2,92 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparkrdma_tpu.kernels import bucket_records, fill_round_slots
+from sparkrdma_tpu.kernels import (bucket_records, compact_segments,
+                                   fill_round_slots)
+
+
+def _cols(rows):
+    """Host rows [N, W] -> columnar jnp [W, N]."""
+    return jnp.asarray(np.ascontiguousarray(rows.T))
 
 
 def test_bucket_records_matches_numpy(rng):
     n, p = 200, 8
-    recs = jnp.asarray(rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32))
-    pids = jnp.asarray(rng.integers(0, p, size=n).astype(np.int32))
-    sr, sp, counts, offs = bucket_records(recs, pids, p)
-    np_counts = np.bincount(np.asarray(pids), minlength=p)
+    rows = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    pids_np = rng.integers(0, p, size=n).astype(np.int32)
+    sr, counts, offs = bucket_records(_cols(rows), jnp.asarray(pids_np), p)
+    np_counts = np.bincount(pids_np, minlength=p)
     np.testing.assert_array_equal(np.asarray(counts), np_counts)
     np.testing.assert_array_equal(
         np.asarray(offs), np.concatenate([[0], np.cumsum(np_counts)[:-1]])
     )
-    # stable: records within a bucket keep input order
+    # stable: records within a bucket keep input order; buckets contiguous
+    sr_rows = np.asarray(sr).T
+    off = 0
     for part in range(p):
-        ref = np.asarray(recs)[np.asarray(pids) == part]
-        got = np.asarray(sr)[np.asarray(sp) == part]
+        ref = rows[pids_np == part]
+        got = sr_rows[off:off + len(ref)]
         np.testing.assert_array_equal(got, ref)
+        off += len(ref)
 
 
 def test_fill_round_slots_covers_all_records_across_rounds(rng):
     n, p, cap = 100, 4, 8
-    recs = jnp.asarray(rng.integers(1, 2**32, size=(n, 4), dtype=np.uint32))
-    pids = jnp.asarray((rng.integers(0, p, size=n) ** 2 % p).astype(np.int32))
-    sr, sp, counts, offs = bucket_records(recs, pids, p)
+    rows = rng.integers(1, 2**32, size=(n, 4), dtype=np.uint32)
+    pids_np = (rng.integers(0, p, size=n) ** 2 % p).astype(np.int32)
+    sr, counts, offs = bucket_records(_cols(rows), jnp.asarray(pids_np), p)
     rounds = int(np.ceil(np.asarray(counts).max() / cap))
     seen = {part: [] for part in range(p)}
     for r in range(rounds):
-        slots, sc = fill_round_slots(sr, sp, counts, offs, p, cap, r)
+        slots, sc = fill_round_slots(sr, counts, offs, p, cap, r)
+        slots_np = np.asarray(slots)              # [W, P, C]
         for part in range(p):
             k = int(sc[part])
             assert k <= cap
-            seen[part].append(np.asarray(slots[part, :k]))
+            seen[part].append(slots_np[:, part, :k].T)
             # padding beyond count is zero
-            assert not np.any(np.asarray(slots[part, k:]))
+            assert not np.any(slots_np[:, part, k:])
     for part in range(p):
         got = np.concatenate(seen[part]) if seen[part] else np.zeros((0, 4))
-        ref = np.asarray(recs)[np.asarray(pids) == part]
+        ref = rows[pids_np == part]
         np.testing.assert_array_equal(got, ref)
 
 
 def test_fill_round_slots_jittable(rng):
     n, p, cap = 64, 8, 4
-    recs = jnp.asarray(rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32))
+    rows = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
     pids = jnp.asarray(rng.integers(0, p, size=n).astype(np.int32))
 
     @jax.jit
     def step(recs, pids, r):
-        sr, sp, c, o = bucket_records(recs, pids, p)
-        return fill_round_slots(sr, sp, c, o, p, cap, r)
+        sr, c, o = bucket_records(recs, pids, p)
+        return fill_round_slots(sr, c, o, p, cap, r)
 
-    s0, c0 = step(recs, pids, 0)
-    assert s0.shape == (p, cap, 4)
+    s0, c0 = step(_cols(rows), pids, 0)
+    assert s0.shape == (4, p, cap)
     assert int(c0.sum()) <= n
+
+
+def test_compact_segments_matches_manual(rng):
+    s, c, w = 5, 8, 3
+    counts = np.array([3, 0, 8, 1, 5], dtype=np.int32)
+    stream = np.zeros((s * c, w), dtype=np.uint32)
+    expect = []
+    for i in range(s):
+        seg = rng.integers(1, 2**32, size=(int(counts[i]), w), dtype=np.uint32)
+        stream[i * c:i * c + counts[i]] = seg
+        expect.append(seg)
+    expect = np.concatenate(expect)
+    packed, total = compact_segments(_cols(stream), jnp.asarray(counts), 32)
+    assert int(total) == int(counts.sum())
+    packed_rows = np.asarray(packed).T
+    assert np.array_equal(packed_rows[:int(total)], expect)
+    assert np.all(packed_rows[int(total):] == 0)
+
+
+def test_compact_segments_overflow_reported(rng):
+    counts = np.array([4, 4], dtype=np.int32)
+    stream = rng.integers(1, 100, size=(8, 2), dtype=np.uint32)
+    packed, total = compact_segments(_cols(stream), jnp.asarray(counts), 6)
+    assert int(total) == 8  # true count exceeds capacity -> caller detects
+    assert packed.shape == (2, 6)

@@ -32,7 +32,7 @@ def exchange():
 def make_global_records(rng, rt, n_per_dev, w=4):
     n = n_per_dev * rt.num_partitions
     x = rng.integers(1, 2**32, size=(n, w), dtype=np.uint32)
-    return rt.shard_rows(x), x
+    return rt.shard_records(x), x
 
 
 def np_reference_shuffle(x, pids, num_parts, mesh_size, n_per_dev):
@@ -52,18 +52,20 @@ def np_reference_shuffle(x, pids, num_parts, mesh_size, n_per_dev):
 
 def run_and_check(exchange_rt, x_global, x_np, part_fn, num_parts, rng):
     ex, rt = exchange_rt
-    pids = np.asarray(part_fn(jnp.asarray(x_np)))
+    pids = np.asarray(part_fn(jnp.asarray(x_np.T)))
     out, totals, plan = ex.shuffle(x_global, part_fn, num_parts=num_parts)
     n_per_dev = x_np.shape[0] // rt.num_partitions
     ref = np_reference_shuffle(x_np, pids, num_parts, rt.num_partitions,
                                n_per_dev)
-    out_np = np.asarray(out).reshape(rt.num_partitions, plan.out_capacity, -1)
+    cap = plan.out_capacity
+    out_np = np.asarray(out)                      # columnar [W, mesh*cap]
     totals_np = np.asarray(totals)
     for d in range(rt.num_partitions):
         k = int(totals_np[d])
         assert k == len(ref[d]), f"device {d}: {k} != {len(ref[d])}"
-        np.testing.assert_array_equal(out_np[d, :k], ref[d])
-        assert not np.any(out_np[d, k:])
+        dev = out_np[:, d * cap:(d + 1) * cap]
+        np.testing.assert_array_equal(dev[:, :k].T, ref[d])
+        assert not np.any(dev[:, k:])
     # conservation: every record arrives exactly once
     assert totals_np.sum() == x_np.shape[0]
     return plan
@@ -82,7 +84,7 @@ def test_multi_round_streaming(exchange, rng):
     n_per_dev = 64  # worst case 64 records from one src to one dest > 16
     x = rng.integers(1, 2**32, size=(n_per_dev * 8, 4), dtype=np.uint32)
     x[:, 0] = 0  # every record on device 0..7 hashes to partition 0 % 8
-    xg = rt.shard_rows(x)
+    xg = rt.shard_records(x)
     plan = run_and_check(exchange, xg, x, modulo_partitioner(8), 8, rng)
     assert plan.num_rounds == int(np.ceil(64 / 16))
 
@@ -92,7 +94,7 @@ def test_hash_partitioner_balance_and_correctness(exchange, rng):
     xg, xn = make_global_records(rng, rt, 64)
     part = hash_partitioner(8)
     run_and_check(exchange, xg, xn, part, 8, rng)
-    pids = np.asarray(part(jnp.asarray(xn)))
+    pids = np.asarray(part(jnp.asarray(xn.T)))
     counts = np.bincount(pids, minlength=8)
     assert counts.min() > 0.5 * counts.mean()  # rough balance on random keys
 
@@ -113,7 +115,7 @@ def test_range_partitioner_lexicographic(rng):
          [100, 1, 0, 0],      # > [100,0]        -> 1
          [200, 4, 0, 0],      # < [200,5]        -> 1
          [200, 5, 0, 0],      # == splitter 1    -> 2
-         [4000000000, 0, 0, 0]], dtype=np.uint32))
+         [4000000000, 0, 0, 0]], dtype=np.uint32).T)  # columnar
     np.testing.assert_array_equal(np.asarray(part(recs)), [0, 1, 1, 1, 2, 2])
 
 
@@ -123,7 +125,7 @@ def test_empty_partitions_ok(exchange, rng):
     _, rt = exchange
     x = rng.integers(1, 2**32, size=(8 * 8, 4), dtype=np.uint32)
     x[:, 0] = 5
-    xg = rt.shard_rows(x)
+    xg = rt.shard_records(x)
     run_and_check(exchange, xg, x, modulo_partitioner(8), 8, rng)
 
 
@@ -133,7 +135,7 @@ def test_plan_rejects_excessive_skew(exchange, rng):
     ex2 = ShuffleExchange(rt.mesh, rt.axis_name, conf)
     x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
     x[:, 0] = 0
-    xg = rt.shard_rows(x)
+    xg = rt.shard_records(x)
     with pytest.raises(ValueError, match="skew"):
         ex2.plan(xg, modulo_partitioner(8))
 

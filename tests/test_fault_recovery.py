@@ -19,7 +19,7 @@ def _write(manager, handle, rng, n_per_dev=16):
     x = np.zeros((8 * n_per_dev, 4), dtype=np.uint32)
     x[:, 1] = rng.integers(0, handle.num_parts, size=8 * n_per_dev)
     x[:, 2] = rng.integers(0, 2**32, size=8 * n_per_dev, dtype=np.uint32)
-    manager.get_writer(handle).write(manager.runtime.shard_rows(x)).stop(True)
+    manager.get_writer(handle).write(manager.runtime.shard_records(x)).stop(True)
     return x
 
 
