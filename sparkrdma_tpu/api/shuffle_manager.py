@@ -45,6 +45,7 @@ from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
+from sparkrdma_tpu.utils.profiling import annotate
 from sparkrdma_tpu.utils.stats import (ExchangeRecord, ShuffleReadStats,
                                        Timer, barrier)
 
@@ -92,7 +93,7 @@ class ShuffleWriter:
         if not success or self._records is None:
             self._records = None
             return None
-        with Timer() as t:
+        with Timer() as t, annotate("shuffle:plan"):
             self._plan = self._m._exchange.plan(
                 self._records, self._h.partitioner, self._h.num_parts
             )
@@ -169,17 +170,23 @@ class ShuffleReader:
                 # first, so the sort stays a separate program there.
                 fuse_sort = self.key_ordering and not filtered
                 with Timer() as t:
-                    out, totals, incoming = ex.exchange(
-                        writer.records, self._h.partitioner, writer.plan,
-                        self._h.num_parts, shuffle_id=self._h.shuffle_id,
-                        sort_key_words=(conf.key_words if fuse_sort else 0),
-                    )
+                    with annotate("shuffle:exchange"):
+                        out, totals, incoming = ex.exchange(
+                            writer.records, self._h.partitioner,
+                            writer.plan, self._h.num_parts,
+                            shuffle_id=self._h.shuffle_id,
+                            sort_key_words=(conf.key_words if fuse_sort
+                                            else 0),
+                        )
                     if filtered:
-                        out, totals = self._m._filtered(
-                            out, totals, writer.plan, self._h.num_parts,
-                            self.start_partition, self.end_partition)
-                        if self.key_ordering:
-                            out = self._m._sorted(out, totals, writer.plan)
+                        with annotate("shuffle:filter+sort"):
+                            out, totals = self._m._filtered(
+                                out, totals, writer.plan,
+                                self._h.num_parts,
+                                self.start_partition, self.end_partition)
+                            if self.key_ordering:
+                                out = self._m._sorted(out, totals,
+                                                      writer.plan)
                     barrier(out)
                 break
             except FetchFailedError as e:
@@ -349,9 +356,12 @@ class ShuffleManager:
                 "map stage instead of resuming")
         w = ShuffleWriter(self, handle)
         # checkpoints store the columnar [W, N] batch; reshard over N
-        w._records = jax.device_put(
-            records_np,
-            self.runtime.sharding(None, self.runtime.axis_name))
+        # (make_array_from_callback: works when some devices are
+        # non-addressable, unlike a global device_put)
+        w._records = jax.make_array_from_callback(
+            records_np.shape,
+            self.runtime.sharding(None, self.runtime.axis_name),
+            lambda idx: records_np[idx])
         w._plan = plan
         self._writers[handle.shuffle_id] = w
         self._plan_seconds[handle.shuffle_id] = 0.0

@@ -98,14 +98,19 @@ def _make_count_fn(mesh: Mesh, axis_name: str, num_parts: int,
     def local_counts(records):
         pids = partitioner(records).astype(jnp.int32)
         counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
-        return counts[None, :]
+        # all_gather -> replicated [mesh, P] so EVERY process can read the
+        # table locally (multi-host: a sharded output would leave other
+        # processes' rows non-addressable). This is the one-sided
+        # metadata-table read of the reference, made collective.
+        return jax.lax.all_gather(counts, axis_name)
 
     return jax.jit(
         shard_map(
             local_counts,
             mesh=mesh,
             in_specs=(P(None, axis_name),),
-            out_specs=P(axis_name),
+            out_specs=P(),
+            check_vma=False,  # VMA can't infer all_gather replication
         )
     )
 

@@ -122,8 +122,16 @@ class MeshRuntime:
         return NamedSharding(self.mesh, P())
 
     def shard_rows(self, x) -> jax.Array:
-        """Place host data with rows split across the shuffle axis."""
-        return jax.device_put(x, self.sharding())
+        """Place host data with rows split across the shuffle axis.
+
+        Uses ``make_array_from_callback`` so each process materializes
+        only its addressable shards — the same call works single-process
+        and multi-host (where ``device_put`` of a globally-sharded array
+        would fail on non-addressable devices).
+        """
+        x = np.ascontiguousarray(x)
+        return jax.make_array_from_callback(
+            x.shape, self.sharding(), lambda idx: x[idx])
 
     def shard_records(self, rows) -> jax.Array:
         """Host row-major records ``[N, W]`` -> device record batch.
@@ -136,15 +144,13 @@ class MeshRuntime:
         kernel a full-lane operation. Hosts still speak rows (the
         reference's record framing); this is the transpose boundary.
         """
-        import numpy as np
-
-        rows = np.ascontiguousarray(rows)
-        return jax.device_put(rows.T, self.sharding(None, self.axis_name))
+        cols = np.ascontiguousarray(np.ascontiguousarray(rows).T)
+        return jax.make_array_from_callback(
+            cols.shape, self.sharding(None, self.axis_name),
+            lambda idx: cols[idx])
 
     def host_rows(self, cols) -> "np.ndarray":
         """Device columnar batch ``[W, N]`` -> host rows ``[N, W]``."""
-        import numpy as np
-
         return np.ascontiguousarray(np.asarray(cols).T)
 
     # ------------------------------------------------------------------

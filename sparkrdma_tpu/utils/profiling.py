@@ -1,0 +1,62 @@
+"""Profiling hooks — the tracing half of SURVEY.md §5's observability row.
+
+The reference's observability is a per-remote-executor fetch-latency
+histogram printed to the executor log (RdmaShuffleReaderStats, behind
+``spark.shuffle.rdma.collectShuffleReadStats``) plus Spark's own metrics.
+The TPU build keeps the histogram idea in :mod:`sparkrdma_tpu.utils.stats`
+and adds what a compiled SPMD runtime can offer that a JVM plugin cannot:
+XLA device traces. ``trace`` wraps a region in a ``jax.profiler`` trace
+(viewable in TensorBoard/XProf/Perfetto); ``annotate`` names sub-regions
+so exchange phases (plan / exchange / sort) are attributable inside the
+trace timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+log = logging.getLogger("sparkrdma_tpu.profiling")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a jax profiler trace of the enclosed region into ``log_dir``.
+
+    Usage::
+
+        with profiling.trace("/tmp/shuffle-trace"):
+            reader.read()
+    """
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+def annotate(name: str):
+    """Named sub-region annotation visible in the device trace timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """``trace`` when a directory is configured, no-op otherwise."""
+    if log_dir:
+        with trace(log_dir):
+            yield
+    else:
+        yield
+
+
+__all__ = ["trace", "annotate", "maybe_trace"]

@@ -108,9 +108,12 @@ def run_terasort(
                          size=(mesh * records_per_device,
                                manager.conf.record_words), dtype=np.uint32)
         records = rt.shard_records(x)
+        n_records, rec_words = x.shape
     else:
         records = input_records          # columnar [W, N]
-        x = rt.host_rows(records)
+        rec_words, n_records = records.shape
+        # full D2H transpose only when the permutation check needs it
+        x = rt.host_rows(records) if verify else None
 
     # 1-2: sample on-fabric, splitters everywhere
     t0 = time.perf_counter()
@@ -141,8 +144,8 @@ def run_terasort(
                 np.asarray(out), np.asarray(totals), x, kw, plan.out_capacity
             )
         res = TeraSortResult(
-            records=x.shape[0],
-            record_bytes=x.shape[1] * 4,
+            records=n_records,
+            record_bytes=rec_words * 4,
             sample_s=sample_s,
             plan_s=plan_s,
             sort_exchange_s=sort_exchange_s,

@@ -1,0 +1,62 @@
+"""Multi-process worker for the distributed integration test.
+
+Run as: ``python tests/mp_worker.py <process_id> <num_processes> <port>``
+with ``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count``
+set so each process contributes several CPU devices (SURVEY.md §4.3: same
+tests across a real process boundary, without a pod).
+"""
+
+import sys
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    from sparkrdma_tpu.runtime.distributed import initialize_distributed
+
+    assert initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc, process_id=pid,
+    ), "distributed init failed"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.workloads.repartition import run_repartition
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    assert jax.process_count() == nproc
+    conf = ShuffleConf(slot_records=64)
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    rt = manager.runtime
+    mesh_size = rt.num_partitions
+
+    def global_scalar(x):
+        """Replicate a reduction so every process can read it."""
+        return int(np.asarray(jax.jit(
+            jnp.sum, out_shardings=NamedSharding(rt.mesh, P()))(x)))
+
+    # repartition across the process boundary (16 partitions on 8 devices)
+    res = run_repartition(manager, records_per_device=32, num_parts=16,
+                          warmup=False, verify=False, shuffle_id=0)
+    assert res.records == 32 * mesh_size
+
+    # terasort end to end (sample -> range partition -> exchange -> sort)
+    tres, out, totals = run_terasort(manager, records_per_device=32,
+                                     verify=False, warmup=False,
+                                     shuffle_id=2)
+    got = global_scalar(totals)
+    assert got == 32 * mesh_size, f"conservation: {got}"
+
+    # global order across the process boundary: gather each device's
+    # first valid key (replicated min/max path)
+    manager.stop()
+    print(f"MPOK proc={pid} mesh={mesh_size}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
