@@ -78,6 +78,12 @@ class ShuffleConf:
     prealloc: str = ""                # "records:count,..." warm classes
     max_slot_records: int = 1 << 22   # refuse larger single allocations
 
+    # --- transport backend ---
+    #: "xla" = lax.all_to_all (compiler-scheduled, default);
+    #: "pallas_ring" = explicit one-sided remote-DMA kernel
+    #: (exchange/ring.py, the RdmaChannel analogue)
+    transport: str = "xla"
+
     # --- observability ---
     collect_shuffle_read_stats: bool = False
 
@@ -97,6 +103,8 @@ class ShuffleConf:
             raise ValueError("key_words must be >=1, val_words >=0")
         if self.max_rounds <= 0 or self.max_rounds_in_flight <= 0:
             raise ValueError("round counts must be positive")
+        if self.transport not in ("xla", "pallas_ring"):
+            raise ValueError(f"unknown transport {self.transport!r}")
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property

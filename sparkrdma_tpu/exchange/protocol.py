@@ -225,6 +225,14 @@ class ShuffleExchange:
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
+        if self.conf.transport == "pallas_ring":
+            from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
+
+            data_a2a = make_ring_all_to_all(self.mesh, ax)
+        else:
+            def data_a2a(slots):
+                return lax.all_to_all(slots, ax, split_axis=0,
+                                      concat_axis=0, tiled=True)
 
         def local_step(records):
             # --- map side: bucket into per-partition runs -------------
@@ -286,6 +294,9 @@ class ShuffleExchange:
                 mesh=self.mesh,
                 in_specs=(P(None, ax),),
                 out_specs=(P(None, ax), P(ax), P(ax)),
+                # VMA inference cannot type the pallas kernel's varying
+                # device-id arithmetic; the xla transport keeps the check
+                check_vma=(self.conf.transport == "xla"),
             )
         )
 
