@@ -4,30 +4,21 @@ This is the "fake backend" SparkRDMA never had (SURVEY.md §4): real
 ``all_to_all`` semantics on any machine via XLA's forced host platform,
 standing in for an 8-chip ICI mesh.
 
-Platform forcing is subtle in this deployment: a sitecustomize module may
-import jax and register the real-TPU PJRT plugin at interpreter startup
-(and hangs at startup if ``JAX_PLATFORMS=cpu`` is in the *environment*), so
-we cannot rely on env vars alone. Instead: append the forced-host-device
-flag to ``XLA_FLAGS`` before the first backend initialization, then select
-the CPU platform through ``jax.config`` — both still effective after
-``import jax`` as long as no backend has been initialized yet.
+Platform forcing is subtle in this deployment; the recipe (and why env
+vars alone don't work) lives in the shared ``_hostmesh`` module at the repo
+root, also used by ``__graft_entry__.dryrun_multichip``'s subprocess child.
 """
 
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-if "jax" not in sys.modules:
-    # Clean interpreter (no sitecustomize): safe to select via env too.
-    os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _hostmesh import force_cpu_devices  # noqa: E402
+
+assert force_cpu_devices(8), "forced 8-device CPU mesh unavailable"
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
