@@ -1,0 +1,97 @@
+"""Separate fixed dispatch/tunnel overhead from true device time.
+
+Strategy: time k chained applications of an op inside ONE jitted program
+for k in {1, 4, 16}; the slope between k values is the true per-op device
+time, the intercept is the per-call overhead (axon tunnel RTT + dispatch).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 4
+
+
+def chained(op, k):
+    def fn(x):
+        for _ in range(k):
+            x = op(x)
+        return x
+    return jax.jit(fn)
+
+
+def time_call(fn, *args, reps=3):
+    out = fn(*args)
+    barrier(*jax.tree_util.tree_leaves(out))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        barrier(*jax.tree_util.tree_leaves(out))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def probe(name, op, x, ks=(1, 4, 16)):
+    times = [time_call(chained(op, k), x) for k in ks]
+    # slope from the two largest k
+    slope = (times[-1] - times[-2]) / (ks[-1] - ks[-2])
+    intercept = times[0] - slope * ks[0]
+    per_gb = N * W * 4 / 1e9
+    print(f"{name:34s} k={ks}: " +
+          " ".join(f"{t*1e3:8.1f}ms" for t in times) +
+          f"  | per-op {slope*1e3:8.2f} ms ({per_gb/max(slope,1e-9):7.1f} GB/s)"
+          f"  overhead {intercept*1e3:7.1f} ms")
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N}")
+    rng = np.random.default_rng(0)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    probe("copy c+1", lambda c: c + 1, cols)
+    probe("tiny (1 elem) c+1",
+          lambda c: c + 1, jax.device_put(np.ones((1,), np.uint32)))
+    probe("sort rows 1key (axis -1 indep)",
+          lambda c: lax.sort(c, dimension=1), cols, ks=(1, 2, 4))
+    probe("sort 1op full N",
+          lambda c: lax.sort(c.reshape(-1)).reshape(c.shape), cols,
+          ks=(1, 2, 4))
+
+    def sort5(c):
+        f = c.reshape(W, N)
+        out = lax.sort((f[0].astype(jnp.uint8),) + tuple(f[i] for i in range(W)),
+                       num_keys=3, is_stable=True)
+        return jnp.stack(out[1:])
+    probe("sort 5op 3key stable", sort5, cols, ks=(1, 2, 4))
+
+    # chunked sort: [M, L] rows sorted independently, L in VMEM range
+    for L in (8192, 65536, 524288):
+        M = N // L
+        c2 = cols[0].reshape(M, L)
+        probe(f"vmap row sort L={L}",
+              lambda c: lax.sort(c, dimension=1), c2, ks=(1, 2, 4))
+
+    # gather: random permutation applied to [W, N]
+    idx = jax.device_put(rng.permutation(N).astype(np.int32))
+    barrier(idx)
+
+    def gath(c):
+        return jnp.take(c, idx, axis=1)
+    probe("gather perm [W,N]", gath, cols, ks=(1, 2, 4))
+
+
+if __name__ == "__main__":
+    main()

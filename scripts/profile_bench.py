@@ -1,0 +1,105 @@
+"""Decompose the single-chip TeraSort bench cost (run on the real TPU).
+
+Times each pipeline stage in isolation at bench scale plus lax.sort
+microbenches at varying operand counts, to direct the Pallas sort work
+(VERDICT.md "next round" item 2). Usage: python scripts/profile_bench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 4
+REPS = 3
+
+
+def timeit(name, fn, *args):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)          # compile + warm
+    barrier(*jax.tree_util.tree_leaves(out))
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn_j(*args)
+        barrier(*jax.tree_util.tree_leaves(out))
+        ts.append(time.perf_counter() - t0)
+    best = min(ts)
+    gbs = N * W * 4 / best / 1e9
+    print(f"{name:44s} {best*1e3:9.2f} ms   {gbs:8.2f} GB/s(data)")
+    return best
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N} ({N*W*4/2**20:.0f} MiB)")
+    rng = np.random.default_rng(0)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    pids = jax.device_put(
+        rng.integers(0, 8, size=(N,), dtype=np.int32))
+    barrier(cols, pids)
+
+    # --- lax.sort microbenches ------------------------------------------
+    timeit("sort 1op(u32) 1key", lambda a: lax.sort(a), cols[0])
+    timeit("sort 2op 2key", lambda a, b: lax.sort((a, b), num_keys=2),
+           cols[0], cols[1])
+    timeit("sort 3op 2key stable",
+           lambda c: lax.sort((c[0], c[1], c[2]), num_keys=2,
+                              is_stable=True), cols[:3])
+    timeit("sort 5op 1key stable (bucket_records)",
+           lambda p, c: lax.sort((p,) + tuple(c[i] for i in range(W)),
+                                 num_keys=1, is_stable=True), pids, cols)
+    timeit("sort 5op 3key stable (lexsort+valid)",
+           lambda v, c: lax.sort((v,) + tuple(c[i] for i in range(W)),
+                                 num_keys=3, is_stable=True),
+           jnp.zeros((N,), jnp.uint8), cols)
+    timeit("sort 4op 2key stable (lexsort novalid)",
+           lambda c: lax.sort(tuple(c[i] for i in range(W)), num_keys=2,
+                              is_stable=True), cols)
+
+    # --- alternatives ----------------------------------------------------
+    timeit("argsort(u32) + 4x gather",
+           lambda c: jnp.take(c, jnp.argsort(c[0]), axis=1), cols)
+    idx = jax.device_put(rng.permutation(N).astype(np.int32))
+    barrier(idx)
+    timeit("pure gather [W,N] random perm",
+           lambda c, i: jnp.take(c, i, axis=1), cols, idx)
+    timeit("elementwise copy (roofline probe)", lambda c: c + 1, cols)
+    timeit("sum (read roofline probe)",
+           lambda c: jnp.sum(c, dtype=jnp.uint32), cols)
+
+    # one-hot histogram probe (radix building block): 256 bins, matmul path
+    timeit("histogram256 via bincount",
+           lambda p: jnp.bincount(p & 255, length=256), pids)
+
+    # --- pipeline stages at bench geometry (num_parts=1, 1 device) ------
+    from sparkrdma_tpu.kernels.bucketing import (bucket_records,
+                                                 compact_segments,
+                                                 fill_round_slots)
+    from sparkrdma_tpu.kernels.sort import lexsort_cols
+
+    zero_pids = jnp.zeros((N,), jnp.int32)
+    timeit("bucket_records P=1", lambda c, p: bucket_records(c, p, 1),
+           cols, zero_pids)
+    timeit("lexsort_cols kw=2 +valid",
+           lambda c: lexsort_cols(c, 2, jnp.ones((N,), bool)), cols)
+
+    counts = jnp.array([N], jnp.int32)
+    offs = jnp.array([0], jnp.int32)
+    timeit("fill_round_slots P=1 cap=N",
+           lambda c: fill_round_slots(c, counts, offs, 1, N, 0), cols)
+    timeit("compact_segments S=1",
+           lambda c: compact_segments(c, counts, N), cols)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,8 +24,9 @@ Differences forced (and earned) by SPMD:
   ``RdmaMapTaskOutput`` fill.
 - ``RdmaShuffleReader.read`` wraps the fetch in deserialization, optional
   aggregation, and optional key-ordering sort; ``read()`` here mirrors
-  that: exchange, then optional key-ordering (lexsort) — aggregation
-  composes the same way via kernels.
+  that: exchange, then optional combine-by-key (``aggregator=``) or
+  key-ordering sort (``key_ordering=``), fused into the exchange program
+  on full-range reads.
 """
 
 from __future__ import annotations
@@ -123,7 +124,9 @@ class ShuffleReader:
     def __init__(self, manager: "ShuffleManager", handle: ShuffleHandle,
                  start_partition: int = 0,
                  end_partition: Optional[int] = None,
-                 key_ordering: bool = False):
+                 key_ordering: bool = False,
+                 aggregator: Optional[str] = None,
+                 float_payload: bool = False):
         self._m = manager
         self._h = handle
         self.start_partition = start_partition
@@ -135,7 +138,14 @@ class ShuffleReader:
                 f"invalid partition range [{self.start_partition}, "
                 f"{self.end_partition}) for {handle.num_parts} partitions"
             )
+        if aggregator is not None and aggregator not in ("sum", "min",
+                                                         "max"):
+            raise ValueError(f"unsupported aggregator {aggregator!r}")
+        if float_payload and aggregator is None:
+            raise ValueError("float_payload requires an aggregator")
         self.key_ordering = key_ordering
+        self.aggregator = aggregator
+        self.float_payload = float_payload
 
     def read(self, record_stats: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Execute the planned exchange; return ``(records, totals)``.
@@ -149,6 +159,11 @@ class ShuffleReader:
         reduce-task partition-range view of Spark's getReader. With
         ``key_ordering`` each device's kept prefix is lexsorted (the
         ExternalSorter stage of RdmaShuffleReader.read).
+
+        With ``aggregator`` set ("sum"/"min"/"max"), each device's kept
+        rows are combined by key first (Spark's Aggregator stage in
+        RdmaShuffleReader.read): output columns become unique keys with
+        reduced payloads, key-sorted, and ``totals`` counts unique keys.
 
         ``record_stats=False`` suppresses the stats record (used for
         warmup/compile passes so throughput histograms stay honest).
@@ -165,29 +180,51 @@ class ShuffleReader:
                 # a statement about exchange throughput.
                 filtered = (self.start_partition, self.end_partition) != (
                     0, self._h.num_parts)
-                # Full-range sorted reads fuse the sort into the exchange
-                # program (one dispatch); a partition filter must apply
-                # first, so the sort stays a separate program there.
+                # Full-range reads fuse sort/aggregation into the
+                # exchange program (one dispatch); a partition filter
+                # must apply first, so those stay separate programs there.
                 fuse_sort = self.key_ordering and not filtered
+                fuse_agg = (self.aggregator or "") if not filtered else ""
                 with Timer() as t:
-                    with annotate("shuffle:exchange"):
-                        out, totals, incoming = ex.exchange(
-                            writer.records, self._h.partitioner,
-                            writer.plan, self._h.num_parts,
-                            shuffle_id=self._h.shuffle_id,
-                            sort_key_words=(conf.key_words if fuse_sort
-                                            else 0),
-                        )
-                    if filtered:
-                        with annotate("shuffle:filter+sort"):
-                            out, totals = self._m._filtered(
-                                out, totals, writer.plan,
-                                self._h.num_parts,
-                                self.start_partition, self.end_partition)
-                            if self.key_ordering:
-                                out = self._m._sorted(out, totals,
-                                                      writer.plan)
-                    barrier(out)
+                    try:
+                        with annotate("shuffle:exchange"):
+                            out, totals, incoming = ex.exchange(
+                                writer.records, self._h.partitioner,
+                                writer.plan, self._h.num_parts,
+                                shuffle_id=self._h.shuffle_id,
+                                sort_key_words=(conf.key_words if fuse_sort
+                                                else 0),
+                                aggregator=fuse_agg,
+                                float_payload=(self.float_payload
+                                               if fuse_agg else False),
+                            )
+                        if filtered:
+                            with annotate("shuffle:filter+agg+sort"):
+                                out, totals = self._m._filtered(
+                                    out, totals, writer.plan,
+                                    self._h.num_parts,
+                                    self.start_partition,
+                                    self.end_partition)
+                                if self.aggregator:
+                                    out, totals = self._m._aggregated(
+                                        out, totals, writer.plan,
+                                        self.aggregator,
+                                        self.float_payload)
+                                elif self.key_ordering:
+                                    out = self._m._sorted(out, totals,
+                                                          writer.plan)
+                        barrier(out)
+                    except jax.errors.JaxRuntimeError as e:
+                        # A real transport/device failure surfaces as a
+                        # backend runtime error; map it to the retryable
+                        # fetch failure exactly like error CQEs become
+                        # FetchFailedException in the reference
+                        # (RdmaShuffleFetcherIterator failure path).
+                        raise FetchFailedError(
+                            self._h.shuffle_id,
+                            f"backend failure during exchange: {e}",
+                            attempt,
+                        ) from e
                 break
             except FetchFailedError as e:
                 # Spark's contract: FetchFailed -> stage retry from
@@ -288,9 +325,11 @@ class ShuffleManager:
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int = 0,
                    end_partition: Optional[int] = None,
-                   key_ordering: bool = False) -> ShuffleReader:
+                   key_ordering: bool = False,
+                   aggregator: Optional[str] = None,
+                   float_payload: bool = False) -> ShuffleReader:
         return ShuffleReader(self, handle, start_partition, end_partition,
-                             key_ordering)
+                             key_ordering, aggregator, float_payload)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self._registry.unregister(shuffle_id)
@@ -438,6 +477,42 @@ class ShuffleManager:
             ))
             self._filter_cache[key] = fn
         return fn(out, window)
+
+    def _aggregated(self, out: jax.Array, totals: jax.Array,
+                    plan: ShufflePlan, op: str,
+                    float_payload: bool) -> Tuple[jax.Array, jax.Array]:
+        """Per-device combine-by-key of the valid prefix (post-filter).
+
+        The full-range path fuses this into the exchange program; a
+        partition-filtered read applies it here instead, compiled per
+        geometry like :meth:`_sorted`.
+        """
+        from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
+
+        key_words = self.conf.key_words
+        cap = plan.out_capacity
+        key = ("agg", cap, out.shape[0], key_words, op, float_payload)
+        fn = self._filter_cache.get(key)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from sparkrdma_tpu.utils.compat import shard_map
+
+            ax = self.runtime.axis_name
+
+            def local_agg(cols, total):
+                valid = jnp.arange(cap) < total[0]
+                combined, nuniq = combine_by_key_cols(
+                    cols, valid, key_words, op, float_payload)
+                return combined, nuniq[None]
+
+            fn = jax.jit(shard_map(
+                local_agg, mesh=self.runtime.mesh,
+                in_specs=(P(None, ax), P(ax)),
+                out_specs=(P(None, ax), P(ax)),
+            ))
+            self._filter_cache[key] = fn
+        return fn(out, totals)
 
     def _sorted(self, out: jax.Array, totals: jax.Array,
                 plan: ShufflePlan) -> jax.Array:

@@ -218,10 +218,18 @@ class ShuffleExchange:
     def _build_exec(self, num_parts: int, capacity: int, num_rounds: int,
                     out_capacity: int, record_words: int,
                     partitioner: Callable,
-                    sort_key_words: int = 0) -> Callable:
+                    sort_key_words: int = 0,
+                    aggregator: str = "",
+                    float_payload: bool = False) -> Callable:
         """``sort_key_words > 0`` fuses the reduce-side key-ordering sort
         into the same compiled program (one dispatch, one XLA schedule —
-        the RdmaShuffleReader's ExternalSorter stage inlined)."""
+        the RdmaShuffleReader's ExternalSorter stage inlined).
+        ``aggregator`` ("sum"/"min"/"max") fuses the reduce-side combine
+        the same way (the optional Aggregator stage of
+        RdmaShuffleReader.read); output rows become unique keys with
+        reduced payloads (key-sorted, so it subsumes ``sort_key_words``)
+        and ``totals`` becomes the unique-key count. ``float_payload``
+        bitcasts payload words to float32 for the reduction."""
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
@@ -257,9 +265,10 @@ class ShuffleExchange:
                 # (partition p = q*mesh + d lives on device d, local q)
                 slots = slots.reshape(record_words, ppd, mesh_size, capacity
                                       ).transpose(2, 1, 0, 3)
-                recv = lax.all_to_all(
-                    slots, ax, split_axis=0, concat_axis=0, tiled=True
-                )                                           # [mesh, ppd, W, C]
+                # dest-major [mesh, ppd, W, C]: the configured transport
+                # moves row d to device d (xla: lax.all_to_all;
+                # pallas_ring: one-sided remote-DMA descriptors)
+                recv = data_a2a(slots)                      # [mesh, ppd, W, C]
                 recv_rounds.append(recv)
 
             # --- reduce side: concat rounds, compact ------------------
@@ -281,7 +290,15 @@ class ShuffleExchange:
             out, total = compact_segments(
                 stream, chunk_len.reshape(-1), out_capacity
             )
-            if sort_key_words:
+            if aggregator:
+                from sparkrdma_tpu.kernels.aggregate import (
+                    combine_by_key_cols)
+
+                valid = jnp.arange(out_capacity) < total
+                out, total = combine_by_key_cols(
+                    out, valid, self.conf.key_words, aggregator,
+                    float_payload)
+            elif sort_key_words:
                 from sparkrdma_tpu.kernels.sort import lexsort_cols
 
                 valid = jnp.arange(out_capacity) < total
@@ -308,6 +325,8 @@ class ShuffleExchange:
         num_parts: Optional[int] = None,
         shuffle_id: int = -1,
         sort_key_words: int = 0,
+        aggregator: str = "",
+        float_payload: bool = False,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Run the planned exchange.
 
@@ -336,16 +355,18 @@ class ShuffleExchange:
                 f"num_parts {num_parts} != plan's {plan_parts}"
             )
         num_parts = plan_parts
+        if aggregator and aggregator not in ("sum", "min", "max"):
+            raise ValueError(f"unsupported aggregator {aggregator!r}")
         self._maybe_inject_fault(shuffle_id)
         w = records.shape[0]
         key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
-               w, sort_key_words,
+               w, sort_key_words, aggregator, float_payload,
                getattr(partitioner, "cache_key", id(partitioner)))
         fn = self._exec_cache.get(key)
         if fn is None:
             fn = self._build_exec(num_parts, plan.capacity, plan.num_rounds,
                                   plan.out_capacity, w, partitioner,
-                                  sort_key_words)
+                                  sort_key_words, aggregator, float_payload)
             self._exec_cache[key] = fn
         return fn(records)
 

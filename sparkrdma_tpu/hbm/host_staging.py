@@ -18,9 +18,9 @@ still wants native code. This module bridges to ``native/staging.cpp``:
 - graceful **fallback to numpy/stdlib** when the shared library can't be
   built (conf.use_native_staging=False forces the fallback).
 
-The library is built on demand with ``make -C native`` the first time it
-is needed; failures degrade silently to the fallback so the framework
-never requires a toolchain at runtime.
+The library is built on demand with ``make -C sparkrdma_tpu/native`` the
+first time it is needed; failures degrade silently to the fallback so the
+framework never requires a toolchain at runtime.
 """
 
 from __future__ import annotations
@@ -37,8 +37,9 @@ import numpy as np
 
 log = logging.getLogger("sparkrdma_tpu.staging")
 
-_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
-_NATIVE_DIR = _REPO_ROOT / "native"
+# native/ ships inside the package (pyproject package-data) so installed
+# wheels can build the library on demand too, not just source checkouts.
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "build" / "libsparkstaging.so"
 
 _lib_lock = threading.Lock()
@@ -257,12 +258,17 @@ class SpillWriter:
             self._fb_q.put((path, arr))
 
     def drain(self) -> int:
-        """Block until all writes land; return error count; drop refs."""
+        """Block until all writes land; return THIS batch's error count.
+
+        The counter resets on drain (both native and fallback paths), so
+        a long-lived writer reused after one failed batch does not keep
+        reporting stale errors."""
         if self._handle is not None:
             errors = int(self._lib.sr_spooler_drain(self._handle))
         else:
             self._fb_q.join()
             errors = self._fb_errors
+            self._fb_errors = 0
         self._pending.clear()
         return errors
 

@@ -25,20 +25,26 @@ from sparkrdma_tpu.kernels.sort import lexsort_records
 
 
 def make_sampler(mesh: Mesh, axis_name: str, key_words: int,
-                 samples_per_device: int) -> Callable:
+                 samples_per_device: int, seed: int = 0) -> Callable:
     """Compiled step: global records -> replicated sample matrix.
 
-    Sampling is strided (every k-th record after a per-device offset) —
-    cheap, deterministic, and adequate for quantile estimation on data
-    that is not adversarially ordered; callers can pre-permute otherwise.
+    Sampling is uniform-random with replacement from each device's local
+    records, seeded per device (``fold_in(seed, axis_index)``) so it is
+    deterministic yet order-insensitive — the SPMD equivalent of Spark
+    RangePartitioner's per-partition reservoir sample. A strided sample
+    (the previous design) skews the splitters badly on pre-sorted or
+    clustered input; random indices have no such failure mode, and
+    with-replacement vs reservoir makes no difference to quantile
+    estimates at these sample sizes.
     Returns ``uint32[mesh * samples_per_device, key_words]`` replicated.
     """
 
     def local_sample(records):
         # records: columnar [W, n_local]
         n = records.shape[1]
-        stride = max(1, n // samples_per_device)
-        idx = (jnp.arange(samples_per_device) * stride) % jnp.maximum(n, 1)
+        dev = jax.lax.axis_index(axis_name)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), dev)
+        idx = jax.random.randint(key, (samples_per_device,), 0, max(n, 1))
         sample = jnp.stack(
             [jnp.take(records[w], idx) for w in range(key_words)], axis=1
         )  # [samples, key_words] — tiny, row-major is fine

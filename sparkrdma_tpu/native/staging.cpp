@@ -256,13 +256,17 @@ int sr_spooler_submit(void* spooler, const char* path, const void* buf,
   return 0;
 }
 
-// Wait until all submitted writes completed; returns error count so far.
+// Wait until all submitted writes completed; returns the error count for
+// THIS batch (the counter resets on drain, so a long-lived spooler reused
+// after one failed batch does not report stale errors forever).
 long sr_spooler_drain(void* spooler) {
   Spooler* sp = static_cast<Spooler*>(spooler);
   std::unique_lock<std::mutex> lk(sp->mu);
   sp->cv_done.wait(lk,
                    [sp] { return sp->queue.empty() && sp->in_flight == 0; });
-  return sp->errors;
+  long batch_errors = sp->errors;
+  sp->errors = 0;
+  return batch_errors;
 }
 
 void sr_spooler_destroy(void* spooler) {

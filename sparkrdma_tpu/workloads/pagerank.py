@@ -32,7 +32,6 @@ from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
-from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.stats import barrier
 
@@ -94,8 +93,12 @@ def run_pagerank(
         off += k
 
     w = conf.record_words
-    if w < 3:
-        raise ValueError("pagerank needs record_words >= 3 (2 key + 1 payload)")
+    if w < 3 or conf.key_words != 2:
+        # the record layout below hardcodes key words [0, 1] and payload
+        # word 2; the fused "sum" aggregator groups by conf.key_words, so
+        # any other key geometry would combine on the wrong words
+        raise ValueError("pagerank needs key_words == 2 and "
+                         "record_words >= 3 (2 key + 1 payload)")
 
     # static record keys: [hi=0, lo=dst]; payload word 2 = rank contribution
     base = np.zeros((mesh * epad, w), dtype=np.uint32)
@@ -134,14 +137,13 @@ def run_pagerank(
         return base_local.at[2].set(payload)
 
     def update_ranks(received, total, outdeg_local):
-        # combine contributions by dst key, scatter into the owner slice
-        # received: columnar [w, out_cap]
-        valid = jnp.arange(out_cap) < total[0]
-        combined, nuniq = combine_by_key_cols(received, valid, 2, op="sum",
-                                              float_payload=True)
-        dst = combined[1].astype(jnp.int32)
-        sums = jax.lax.bitcast_convert_type(combined[2], jnp.float32)
-        live = jnp.arange(out_cap) < nuniq
+        # received is already combined by dst key (the exchange fuses the
+        # "sum" aggregator — the reader-level Aggregator stage); scatter
+        # the per-key sums into the owner's dense rank slice.
+        # received: columnar [w, out_cap], total[0] = unique keys
+        dst = received[1].astype(jnp.int32)
+        sums = jax.lax.bitcast_convert_type(received[2], jnp.float32)
+        live = jnp.arange(out_cap) < total[0]
         idx = jnp.where(live, dst // mesh, vper)
         acc = jnp.zeros((vper,), jnp.float32).at[idx].add(
             jnp.where(live, sums, 0.0), mode="drop")
@@ -169,7 +171,8 @@ def run_pagerank(
     for _ in range(iterations):
         records = build_fn(ranks, base_global, src_idx, emask_global,
                            outdeg_owner)
-        out, totals, _ = ex.exchange(records, part, plan, mesh)
+        out, totals, _ = ex.exchange(records, part, plan, mesh,
+                                     aggregator="sum", float_payload=True)
         ranks = update_fn(out, totals, outdeg_owner)
         # Per-iteration barrier: each shuffle iteration is a Spark stage
         # boundary (BSP). Also keeps the async dispatch queue shallow —

@@ -211,3 +211,80 @@ def test_reader_rejects_bad_range(manager):
             manager.get_reader(handle, start_partition=start,
                                end_partition=end)
     manager.unregister_shuffle(42)
+
+
+def _np_combine_sum(x, key_words=2):
+    """numpy reference: per unique key (lexicographic), sum payload words."""
+    keys = (x[:, 0].astype(np.uint64) << np.uint64(32)) | x[:, 1]
+    uniq, inv = np.unique(keys, return_inverse=True)
+    sums = np.zeros((len(uniq), x.shape[1] - key_words), np.uint64)
+    for c in range(x.shape[1] - key_words):
+        np.add.at(sums[:, c], inv, x[:, key_words + c])
+    return uniq, (sums & 0xFFFFFFFF).astype(np.uint32)  # uint32 wraparound
+
+
+def test_reader_aggregation_fused(manager, rng):
+    """get_reader(aggregator="sum"): per-device combined, key-sorted output
+    matching a numpy groupby — the Aggregator stage of the reference's
+    RdmaShuffleReader.read, fused into the exchange program."""
+    part = modulo_partitioner(8, key_word=1)
+    handle = manager.register_shuffle(30, 8, part)
+    try:
+        n = 8 * 64
+        x = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+        x[:, 0] = 0
+        x[:, 1] = rng.integers(0, 40, size=n)   # few keys -> real combining
+        manager.get_writer(handle).write(
+            manager.runtime.shard_records(x)).stop(True)
+        out, totals = manager.get_reader(handle, aggregator="sum").read()
+        out_np, totals_np = np.asarray(out), np.asarray(totals)
+        plan = manager._writers[30].plan
+        cap = plan.out_capacity
+        got = []
+        for d in range(8):
+            k = int(totals_np[d])
+            dev = out_np[:, d * cap:d * cap + k].T
+            assert np.all(np.diff(dev[:, 1].astype(np.int64)) > 0), \
+                "keys must be unique and sorted per device"
+            assert np.all(dev[:, 1] % 8 == d), "keys on the wrong device"
+            got.append(dev)
+        got = np.concatenate(got)
+        uniq, sums = _np_combine_sum(x)
+        assert len(got) == len(uniq)
+        order = np.argsort(got[:, 1])
+        np.testing.assert_array_equal(got[order, 1].astype(np.uint64), uniq)
+        np.testing.assert_array_equal(got[order, 2:], sums)
+    finally:
+        manager.unregister_shuffle(30)
+
+
+def test_reader_aggregation_filtered_range(manager, rng):
+    """Partition-filtered read + aggregator: combine applies post-filter."""
+    part = modulo_partitioner(8, key_word=1)
+    handle = manager.register_shuffle(31, 8, part)
+    try:
+        n = 8 * 32
+        x = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+        x[:, 0] = 0
+        x[:, 1] = rng.integers(0, 24, size=n)
+        manager.get_writer(handle).write(
+            manager.runtime.shard_records(x)).stop(True)
+        out, totals = manager.get_reader(
+            handle, start_partition=2, end_partition=5,
+            aggregator="sum").read()
+        out_np, totals_np = np.asarray(out), np.asarray(totals)
+        plan = manager._writers[31].plan
+        cap = plan.out_capacity
+        kept = x[(x[:, 1] % 8 >= 2) & (x[:, 1] % 8 < 5)]
+        uniq, sums = _np_combine_sum(kept)
+        got = []
+        for d in range(8):
+            k = int(totals_np[d])
+            got.append(out_np[:, d * cap:d * cap + k].T)
+        got = np.concatenate(got)
+        assert len(got) == len(uniq)
+        order = np.argsort(got[:, 1])
+        np.testing.assert_array_equal(got[order, 1].astype(np.uint64), uniq)
+        np.testing.assert_array_equal(got[order, 2:], sums)
+    finally:
+        manager.unregister_shuffle(31)
