@@ -2,15 +2,24 @@
 
 Runs the full shuffle pipeline (range-partition -> slotted all_to_all
 exchange -> per-chip lexicographic sort) over all visible devices and
-reports shuffled GB/s per chip. Baseline is the reference's transport
-ceiling: SparkRDMA rides a 100Gb/s RoCE/IB NIC, i.e. 12.5 GB/s per node
-(BASELINE.md); on one TPU chip the exchange degenerates to the on-chip
-pipeline, which is exactly the part the NIC could never help with.
+reports steady-state shuffled GB/s per chip: the timed region re-runs the
+complete exchange+sort BENCH_REPEATS times back-to-back (per-dispatch /
+tunnel latency amortized, output buffers ping-ponging through the slot
+pool), matching how line-rate NIC figures are measured. Baseline is the
+reference's transport ceiling: SparkRDMA rides a 100Gb/s RoCE/IB NIC,
+i.e. 12.5 GB/s per node (BASELINE.md); on one TPU chip the exchange
+degenerates to the on-chip pipeline, which is exactly the part the NIC
+could never help with.
+
+Correctness is asserted in-run by the on-device invariant check
+(conservation checksums + intra/inter-device key order,
+``workloads.terasort.device_verify_sort``) — cheap at bench scale, unlike
+the host-side permutation proof that tests/ run at test scale.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: BENCH_RECORDS_PER_DEVICE (default 16M ~= 256MB/chip),
-BENCH_PAYLOAD_WORDS (default 2).
+BENCH_REPEATS (default 8).
 """
 
 import json
@@ -18,9 +27,10 @@ import os
 import sys
 
 
-def main() -> None:
+def main() -> int:
     records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
                                             16 * 1024 * 1024))
+    repeats = int(os.environ.get("BENCH_REPEATS", 8))
     import jax
 
     from sparkrdma_tpu import MeshRuntime, ShuffleConf
@@ -34,17 +44,22 @@ def main() -> None:
     slot = max(4096, records_per_device)
     conf = ShuffleConf(slot_records=slot,
                        max_rounds=64,
+                       max_slot_records=max(1 << 22, 2 * slot),
                        collect_shuffle_read_stats=False)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
         res, _, _ = run_terasort(
             manager,
             records_per_device=records_per_device,
-            verify=False,   # full host-side permutation check is O(n log n)
-                            # on host; correctness is covered by tests/
+            verify=False,          # host permutation proof is test-scale
+            device_verify=True,    # on-device invariants at bench scale
             warmup=True,
+            repeats=repeats,
             shuffle_id=0,
         )
+        if not res.verified:
+            print(json.dumps({"error": "device verification FAILED"}))
+            return 1
         gbps_per_chip = res.gbps / mesh_size
         baseline_gbps = 12.5  # 100Gb/s RoCE per node, BASELINE.md
         print(json.dumps({
@@ -53,6 +68,7 @@ def main() -> None:
             "unit": "GB/s/chip",
             "vs_baseline": round(gbps_per_chip / baseline_gbps, 3),
         }))
+        return 0
     finally:
         manager.stop()
 

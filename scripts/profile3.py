@@ -1,0 +1,138 @@
+"""Round 3: decide the fast-sort formulation.
+
+Questions:
+ a) chunked (vmap) 5-operand sort vs monolithic — cost per pass?
+ b) does operand count scale cost (1 vs 3 vs 5 operands, monolithic)?
+ c) searchsorted-based counts vs bincount at 16M?
+ d) is lax.sort data-adaptive (random vs pre-sorted vs bucketed input)?
+ e) fused (valid-lead) chunked sort with masking?
+
+Timing: k-chained programs, slope method (see profile2); all ops keep the
+data 'live' by xoring a round counter into one word so chained reps do not
+degenerate to sorting sorted data (except the explicit 'presorted' probe).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 4
+
+
+def perturb(c):
+    """Cheap re-randomization so rep r doesn't sort rep r-1's output."""
+    return c ^ (c << 13) ^ (c >> 7)
+
+
+def probe(name, op, x, ks=(1, 3), reperturb=True):
+    def chained(k):
+        def fn(x):
+            for i in range(k):
+                x = op(perturb(x) if (reperturb and i > 0) else x)
+            return x
+        return jax.jit(fn)
+
+    times = []
+    for k in ks:
+        fn = chained(k)
+        out = fn(x)
+        barrier(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(x)
+            barrier(out)
+            ts.append(time.perf_counter() - t0)
+        times.append(min(ts))
+    slope = (times[-1] - times[0]) / (ks[-1] - ks[0])
+    print(f"{name:44s} " + " ".join(f"{t*1e3:8.1f}ms" for t in times) +
+          f"  | per-op {slope*1e3:8.2f} ms")
+    return slope
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N}")
+    rng = np.random.default_rng(0)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    def sort5(c):
+        out = lax.sort(tuple(c[i] for i in range(W)), num_keys=2,
+                       is_stable=True)
+        return jnp.stack(out)
+    probe("monolithic 4op 2key random", sort5, cols)
+
+    def sort1key(c):
+        pid = c[0] >> 23  # 9-bit bucket id
+        out = lax.sort((pid,) + tuple(c[i] for i in range(W)), num_keys=1,
+                       is_stable=True)
+        return jnp.stack(out[1:])
+    probe("monolithic 5op 1key(9bit) random", sort1key, cols)
+
+    # pre-bucketed input: sort AGAIN by full key after bucketing by top 9
+    bucketed = sort1key(cols)
+    barrier(bucketed)
+    probe("monolithic 4op 2key on bucketed", sort5, bucketed,
+          reperturb=False)
+    srt = sort5(cols)
+    barrier(srt)
+    probe("monolithic 4op 2key presorted", sort5, srt, reperturb=False)
+
+    # chunked variadic sorts: [W, M, L] sort along L
+    for L in (8192, 65536, 262144):
+        M = N // L
+        c3 = cols.reshape(W, M, L)
+
+        def sortc(c, L=L, M=M):
+            out = lax.sort(tuple(c[i] for i in range(W)), num_keys=2,
+                           is_stable=True, dimension=1)
+            return jnp.stack(out)
+
+        def op(c):
+            return sortc(c.reshape(W, M, L)).reshape(W, M * L) \
+                .reshape(W, M, L)
+        probe(f"chunked 4op 2key L={L}", lambda c: sortc(c), c3)
+
+    # chunked with validity lead key (the fused-compaction variant)
+    L = 262144
+    M = N // L
+    c3 = cols.reshape(W, M, L)
+    lead = jnp.zeros((M, L), jnp.uint8)
+
+    def sortv(c):
+        out = lax.sort((lead,) + tuple(c[i] for i in range(W)), num_keys=3,
+                       is_stable=True, dimension=1)
+        return jnp.stack(out[1:])
+    probe(f"chunked 5op 3key(+valid) L={L}", sortv, c3)
+
+    # histogram candidates at P=512
+    pids = jax.device_put(rng.integers(0, 512, size=(N,), dtype=np.int32))
+    barrier(pids)
+    probe("bincount P=512", lambda p: jnp.bincount(p, length=512) + 0 * p[:1],
+          pids, reperturb=False)
+    spids = jnp.sort(pids)
+    barrier(spids)
+    probe("searchsorted counts P=512 (sorted pids)",
+          lambda p: jnp.searchsorted(p, jnp.arange(513)) + 0 * p[:1],
+          spids, reperturb=False)
+
+    def onehot_hist(p):
+        oh = (p[:, None] >> jnp.arange(9)[None, :]) & 1  # cheap proxy probe
+        return jnp.sum(oh, axis=0) + 0 * p[:1]
+    probe("bit-sum proxy (one-hot cost floor)", onehot_hist, pids,
+          reperturb=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -213,7 +213,13 @@ class ShuffleReader:
                                 elif self.key_ordering:
                                     out = self._m._sorted(out, totals,
                                                           writer.plan)
-                        barrier(out)
+                        if record_stats:
+                            # the hard sync exists to time exec_s and to
+                            # surface device failures inside the retry
+                            # wrap; un-recorded reads (warmup, steady-
+                            # state loops) stay async so dispatches
+                            # pipeline without a host round-trip each
+                            barrier(out)
                     except jax.errors.JaxRuntimeError as e:
                         # A real transport/device failure surfaces as a
                         # backend runtime error; map it to the retryable
@@ -301,8 +307,11 @@ class ShuffleManager:
             store = MapOutputStore(self.conf.spill_dir,
                                    use_native=self.conf.use_native_staging)
         self.store = store
+        # the runtime's SlotPool serves exchange recv/output buffers
+        # (RdmaBufferManager wiring: the node owns the pool, channels use it)
         self._exchange = ShuffleExchange(self.runtime.mesh,
-                                         self.runtime.axis_name, self.conf)
+                                         self.runtime.axis_name, self.conf,
+                                         pool=self.runtime.pool)
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
         self._registry = MapOutputRegistry(ids)
@@ -335,6 +344,10 @@ class ShuffleManager:
         self._registry.unregister(shuffle_id)
         self._writers.pop(shuffle_id, None)
         self._plan_seconds.pop(shuffle_id, None)
+        # dispose: recycled output buffers go back to the pool (callers
+        # must have consumed this shuffle's reads by now — the reference
+        # frees registered buffers on unregisterShuffle the same way)
+        self._exchange.release_shuffle(shuffle_id)
         if self.store is not None:  # shuffle files removed on unregister
             self.store.delete(shuffle_id)
 

@@ -67,8 +67,13 @@ class ShuffleConf:
     # --- exchange geometry (maxAggBlock / bytes-in-flight analogues) ---
     slot_records: int = 4096          # records per (src,dst) slot per round
     max_rounds: int = 64              # static upper bound on streaming rounds
-    max_rounds_in_flight: int = 2     # double-buffering depth
-    queue_depth: int = 8              # completed-slot queue bound (recvQueueDepth)
+    #: rounds fused into ONE compiled exchange program; shuffles needing
+    #: more rounds stream them as separate chunk programs of this many
+    #: rounds each (the fetcher's bytes-in-flight dispatch granularity)
+    max_rounds_in_flight: int = 2
+    #: outstanding streaming chunks before the host blocks on the oldest
+    #: (recvQueueDepth: bounds live recv-slot memory to queue_depth chunks)
+    queue_depth: int = 8
 
     # --- record geometry ---
     key_words: int = DEFAULT_KEY_WORDS   # uint32 words per key

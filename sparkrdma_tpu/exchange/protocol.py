@@ -6,28 +6,47 @@ per remote executor, RDMA-READs each executor's ``RdmaMapTaskOutput`` table,
 aggregates adjacent blocks up to ``maxAggBlock``, throttles bytes in flight,
 and posts one-sided READs into pooled registered buffers
 (src/main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleFetcherIterator
-.scala §fetchBlocks / §next), here the same job is one compiled SPMD
-program:
+.scala §fetchBlocks / §next), here the same job is a small number of
+compiled SPMD programs:
 
 1. **Size exchange** — a [P]-vector ``all_to_all`` of per-destination record
    counts. This *is* the metadata fetch: one-sided, no driver hot spot,
    ~16B x P per chip (the reference reads RdmaMapTaskOutput tables by RDMA
    READ for the same reason — SURVEY.md §2.3 design point).
-2. **Data rounds** — ``num_rounds`` fixed-shape ``all_to_all``s of
-   ``[P, capacity, W]`` slot tensors. Fixed capacity is the XLA-legal form
-   of block aggregation (``maxAggBlock``); partitions bigger than one slot
-   stream across rounds exactly like the reference's chunked READs through
-   bounded buffers. Rounds are unrolled in one traced program so XLA can
-   overlap round r+1's packing with round r's collective — the analogue of
-   the fetcher overlapping fetch with consumption.
+2. **Data rounds** — fixed-shape ``all_to_all``s of ``[P, capacity, W]``
+   slot tensors. Fixed capacity is the XLA-legal form of block aggregation
+   (``maxAggBlock``); partitions bigger than one slot stream across rounds
+   exactly like the reference's chunked READs through bounded buffers.
 3. **Compaction** — received slots are squeezed into one dense local
    partition (the result-queue drain + stream concat).
+
+Execution has two regimes, switched on ``conf.max_rounds_in_flight`` (the
+bytes-in-flight throttle of the reference's fetcher):
+
+- ``num_rounds <= max_rounds_in_flight``: ONE fused program (bucket, size
+  exchange, all rounds, compaction, optional fused sort/aggregation) —
+  one dispatch, XLA overlaps packing with collectives.
+- more rounds than that: **streaming** — a prep program (bucket + size
+  exchange), then round *chunks* of ``max_rounds_in_flight`` rounds each
+  dispatched as separate programs whose recv buffers come from the
+  :class:`~sparkrdma_tpu.hbm.slot_pool.SlotPool` and are folded into a
+  donated output accumulator as they complete. Live slot memory is
+  bounded by ``conf.queue_depth`` outstanding chunks (the recvQueueDepth
+  analogue): the host blocks on chunk ``j - queue_depth`` before
+  dispatching chunk ``j``.
 
 The number of rounds is data-dependent, so a shuffle is *planned* first
 (:func:`plan_shuffle` — one tiny compiled step + host reduction) and then
 *executed* with static geometry (:meth:`ShuffleExchange.exchange`). This
 two-phase structure is the reference's own: fetch metadata, then size and
 issue the reads.
+
+Buffer reuse contract (``RdmaRegisteredBuffer`` semantics): when the
+exchange was constructed with a pool, the output array of
+:meth:`ShuffleExchange.exchange` is recycled as the donated output buffer
+of the NEXT same-geometry exchange — consume (or copy) it before then,
+exactly as the reference's fetch results are pooled buffers released back
+to ``RdmaBufferManager`` after the reader drains them.
 
 Partitions-per-device: ``num_parts`` must equal the mesh axis size times an
 integer ``parts_per_device``; partition ``p`` lives on device ``p %
@@ -39,14 +58,13 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from functools import partial
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkrdma_tpu.config import ShuffleConf, size_class
 from sparkrdma_tpu.kernels.bucketing import (bucket_records, compact_segments,
@@ -126,13 +144,25 @@ class ShuffleExchange:
     """
 
     def __init__(self, mesh: Mesh, axis_name: str,
-                 conf: Optional[ShuffleConf] = None):
+                 conf: Optional[ShuffleConf] = None,
+                 pool=None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
         self.mesh_size = int(mesh.shape[axis_name])
+        self.pool = pool
         self._exec_cache: Dict[Tuple, Callable] = {}
         self._count_cache: Dict[Tuple, Callable] = {}
+        # previous output per (shuffle_id, geometry), recycled as the next
+        # donated output buffer of a REPEAT read of the same shuffle, and
+        # released to the pool on release_shuffle (unregisterShuffle ->
+        # dispose -> RdmaBufferManager.put in the reference). Keying on
+        # shuffle_id keeps concurrent shuffles' outputs independent (a
+        # join legitimately holds two same-geometry outputs at once).
+        self._out_prev: Dict[Tuple, Tuple[jax.Array, object]] = {}
+        #: programs dispatched by the most recent exchange() — observability
+        #: for the in-flight machinery (1 = fused path)
+        self.last_dispatches = 0
         # Fault injection (SURVEY.md §5: the reference has no fault
         # tooling in-repo; the build adds the hook the exchange loop
         # needs for testing job-level retry). ``fault_hook`` (tests)
@@ -213,14 +243,56 @@ class ShuffleExchange:
         )
 
     # ------------------------------------------------------------------
-    # phase 2: execute (the data plane)
+    # transports
+    # ------------------------------------------------------------------
+    def _data_a2a(self) -> Callable:
+        """The configured data-round transport: dest-major slot tensor
+        ``[mesh, ...]`` -> source-major received tensor."""
+        ax = self.axis_name
+        if self.conf.transport == "pallas_ring":
+            from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
+
+            return make_ring_all_to_all(self.mesh, ax)
+
+        def a2a(slots):
+            return lax.all_to_all(slots, ax, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
+        return a2a
+
+    def _fuse_tail(self, out, total, out_capacity, sort_key_words,
+                   aggregator, float_payload, tight_out=False):
+        """Optional fused reduce-side stages (sort / combine-by-key).
+
+        ``tight_out``: the plan proved every device's output is exactly
+        full (totals == out_capacity), so the sort can drop its
+        validity lead operand — one fewer array through the comparator
+        network."""
+        if aggregator:
+            from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
+
+            valid = jnp.arange(out_capacity) < total
+            out, total = combine_by_key_cols(
+                out, valid, self.conf.key_words, aggregator, float_payload)
+        elif sort_key_words:
+            from sparkrdma_tpu.kernels.sort import lexsort_cols
+
+            valid = (None if tight_out
+                     else jnp.arange(out_capacity) < total)
+            out = lexsort_cols(out, sort_key_words, valid)
+        return out, total
+
+    # ------------------------------------------------------------------
+    # phase 2, regime A: one fused program
     # ------------------------------------------------------------------
     def _build_exec(self, num_parts: int, capacity: int, num_rounds: int,
                     out_capacity: int, record_words: int,
                     partitioner: Callable,
                     sort_key_words: int = 0,
                     aggregator: str = "",
-                    float_payload: bool = False) -> Callable:
+                    float_payload: bool = False,
+                    donate_out: bool = False,
+                    tight_out: bool = False) -> Callable:
         """``sort_key_words > 0`` fuses the reduce-side key-ordering sort
         into the same compiled program (one dispatch, one XLA schedule —
         the RdmaShuffleReader's ExternalSorter stage inlined).
@@ -229,20 +301,15 @@ class ShuffleExchange:
         RdmaShuffleReader.read); output rows become unique keys with
         reduced payloads (key-sorted, so it subsumes ``sort_key_words``)
         and ``totals`` becomes the unique-key count. ``float_payload``
-        bitcasts payload words to float32 for the reduction."""
+        bitcasts payload words to float32 for the reduction.
+        ``donate_out``: program takes a same-shape output buffer to donate
+        (pool-served; the full-overwrite write-through lets XLA alias)."""
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
-        if self.conf.transport == "pallas_ring":
-            from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
+        data_a2a = self._data_a2a()
 
-            data_a2a = make_ring_all_to_all(self.mesh, ax)
-        else:
-            def data_a2a(slots):
-                return lax.all_to_all(slots, ax, split_axis=0,
-                                      concat_axis=0, tiled=True)
-
-        def local_step(records):
+        def local_step(records, *maybe_buf):
             # --- map side: bucket into per-partition runs -------------
             # records: columnar [W, n_local]
             pids = partitioner(records).astype(jnp.int32)
@@ -290,33 +357,263 @@ class ShuffleExchange:
             out, total = compact_segments(
                 stream, chunk_len.reshape(-1), out_capacity
             )
-            if aggregator:
-                from sparkrdma_tpu.kernels.aggregate import (
-                    combine_by_key_cols)
-
-                valid = jnp.arange(out_capacity) < total
-                out, total = combine_by_key_cols(
-                    out, valid, self.conf.key_words, aggregator,
-                    float_payload)
-            elif sort_key_words:
-                from sparkrdma_tpu.kernels.sort import lexsort_cols
-
-                valid = jnp.arange(out_capacity) < total
-                out = lexsort_cols(out, sort_key_words, valid)
+            out, total = self._fuse_tail(out, total, out_capacity,
+                                         sort_key_words, aggregator,
+                                         float_payload, tight_out)
+            if maybe_buf:
+                # full-extent write-through into the donated pooled
+                # buffer: same shape in and out, so XLA aliases the pages
+                # (registered-buffer reuse)
+                out = lax.dynamic_update_slice(maybe_buf[0], out, (0, 0))
             return out, total[None], incoming[None]
 
+        in_specs = [P(None, ax)]
+        if donate_out:
+            in_specs.append(P(None, ax))
         return jax.jit(
             shard_map(
                 local_step,
                 mesh=self.mesh,
-                in_specs=(P(None, ax),),
+                in_specs=tuple(in_specs),
                 out_specs=(P(None, ax), P(ax), P(ax)),
                 # VMA inference cannot type the pallas kernel's varying
                 # device-id arithmetic; the xla transport keeps the check
                 check_vma=(self.conf.transport == "xla"),
-            )
+            ),
+            donate_argnums=((1,) if donate_out else ()),
         )
 
+    # ------------------------------------------------------------------
+    # phase 2, regime B: streaming round chunks (bounded in-flight)
+    # ------------------------------------------------------------------
+    def _build_prep(self, num_parts: int, record_words: int,
+                    partitioner: Callable) -> Callable:
+        """records -> (bucketed, counts, offsets, incoming, totals)."""
+        mesh_size = self.mesh_size
+        ax = self.axis_name
+
+        def local_prep(records):
+            pids = partitioner(records).astype(jnp.int32)
+            sr, counts, offs = bucket_records(records, pids, num_parts)
+            dev_counts = _device_partition_counts(
+                counts, num_parts, mesh_size, ax)
+            incoming = lax.all_to_all(
+                dev_counts, ax, split_axis=0, concat_axis=0, tiled=True)
+            total = jnp.sum(incoming).astype(jnp.int32)
+            return sr, counts, offs, incoming[None], total[None]
+
+        return jax.jit(shard_map(
+            local_prep, mesh=self.mesh,
+            in_specs=(P(None, ax),),
+            out_specs=(P(None, ax), P(ax), P(ax), P(ax), P(ax)),
+            check_vma=(self.conf.transport == "xla"),
+        ))
+
+    def _build_chunk(self, num_parts: int, capacity: int, rounds_per: int,
+                     record_words: int) -> Callable:
+        """(bucketed, counts, offsets, r0, recv_buf) -> filled recv_buf.
+
+        Runs ``rounds_per`` rounds starting at traced round index ``r0``;
+        one compiled program serves every chunk of the stream (r0 is a
+        device scalar, and rounds past the true end just move zeros).
+        ``recv_buf`` is pool-served and donated; the full-extent
+        write-through aliases it to the output. Per-device output layout:
+        ``[rounds_per, mesh, ppd, W, C]``.
+        """
+        mesh_size = self.mesh_size
+        ppd = num_parts // mesh_size
+        ax = self.axis_name
+        data_a2a = self._data_a2a()
+
+        def local_chunk(sr, counts, offs, r0, recv_buf):
+            recvs = []
+            for j in range(rounds_per):
+                slots, _ = fill_round_slots(
+                    sr, counts, offs, num_parts, capacity, r0[0] + j)
+                slots = slots.reshape(record_words, ppd, mesh_size, capacity
+                                      ).transpose(2, 1, 0, 3)
+                recvs.append(data_a2a(slots))       # [mesh, ppd, W, C]
+            chunk = jnp.stack(recvs, axis=0)  # [rounds_per, mesh, ppd, W, C]
+            return lax.dynamic_update_slice(
+                recv_buf, chunk, (0, 0, 0, 0, 0))
+
+        return jax.jit(shard_map(
+            local_chunk, mesh=self.mesh,
+            in_specs=(P(None, ax), P(ax), P(ax), P(), P(None, ax)),
+            out_specs=P(None, ax),
+            check_vma=False,   # r0 is replicated data; VMA can't type it
+        ), donate_argnums=(4,))
+
+    def _build_fold(self, num_parts: int, capacity: int, rounds_per: int,
+                    total_rounds: int, out_capacity: int,
+                    record_words: int, first: bool) -> Callable:
+        """(acc, recv_chunk, incoming, chunk_idx) -> acc with the chunk's
+        segments written at their exact stream offsets.
+
+        ``acc`` is donated (in-place accumulate). Segment (q, s, r) of the
+        output stream starts at the prefix sum of all earlier segments'
+        valid lengths — computed on device from ``incoming``. Writes are
+        read-blend-write over each [W, C] window so a segment's zero tail
+        never clobbers neighbours written by other chunks (unlike the
+        fused path's ascending-repair trick, chunk arrival order is not
+        stream order).
+        """
+        mesh_size = self.mesh_size
+        ppd = num_parts // mesh_size
+        w = record_words
+        cap = capacity
+
+        def local_fold(acc, recv, incoming, cidx):
+            # acc: [W, out_capacity + cap] — the +cap head-room guarantees
+            # no dynamic_update_slice ever clamps (a clamped window would
+            # shift backward over valid data); the tail program slices it
+            # recv: [rounds_per, mesh, ppd, W, C]
+            # incoming: [1, mesh, ppd] (this device's row)
+            inc = incoming[0]                          # [mesh, ppd]
+            # stream-order segment lengths for ALL rounds: index (q, s, r)
+            r_ix = jnp.arange(total_rounds, dtype=jnp.int32)
+            seg_len = jnp.clip(
+                inc.T[:, :, None] - r_ix[None, None, :] * cap, 0, cap
+            )                                          # [ppd, mesh, R]
+            flat_len = seg_len.reshape(-1)
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(flat_len)[:-1].astype(jnp.int32)]
+            ).reshape(ppd, mesh_size, total_rounds)
+            col = jnp.arange(cap, dtype=jnp.int32)[None, :]
+            if first:
+                acc = jnp.zeros_like(acc)
+            for q in range(ppd):
+                for s in range(mesh_size):
+                    for j in range(rounds_per):
+                        r = cidx[0] * rounds_per + j
+                        seg = recv[j, s, q]            # [W, C]
+                        ln = jnp.clip(inc[s, q] - r * cap, 0, cap)
+                        dst = jnp.where(
+                            r < total_rounds,
+                            starts[q, s, jnp.minimum(r, total_rounds - 1)],
+                            acc.shape[1] - cap)  # parked write, len 0
+                        window = lax.dynamic_slice(acc, (0, dst), (w, cap))
+                        blended = jnp.where(col < ln, seg, window)
+                        acc = lax.dynamic_update_slice(acc, blended,
+                                                       (0, dst))
+            # tiny completion token: an undonated output the host can
+            # block on for in-flight pacing (acc itself is donated into
+            # the NEXT fold, so its handle dies before the host would
+            # wait on it)
+            token = acc[:1, :1] + jnp.uint32(0)
+            return acc, token
+
+        ax = self.axis_name
+        return jax.jit(shard_map(
+            local_fold, mesh=self.mesh,
+            in_specs=(P(None, ax), P(None, ax), P(ax), P()),
+            out_specs=(P(None, ax), P(None, ax)),
+            check_vma=False,
+        ), donate_argnums=(0,))
+
+    def _build_tail(self, out_capacity: int, record_words: int,
+                    sort_key_words: int, aggregator: str,
+                    float_payload: bool) -> Callable:
+        """(acc, totals) -> (out, totals): strip the accumulator's
+        head-room column band, then apply optional sort/aggregation."""
+        ax = self.axis_name
+
+        def local_tail(acc, total):
+            out = acc[:, :out_capacity]
+            out, t = self._fuse_tail(out, total[0], out_capacity,
+                                     sort_key_words, aggregator,
+                                     float_payload)
+            return out, t[None]
+
+        return jax.jit(shard_map(
+            local_tail, mesh=self.mesh,
+            in_specs=(P(None, ax), P(ax)),
+            out_specs=(P(None, ax), P(ax)),
+        ))
+
+    def _exchange_streaming(self, records, partitioner, plan, num_parts,
+                            sort_key_words, aggregator, float_payload):
+        """Regime B driver: prep, paced round chunks, folds, tail."""
+        conf = self.conf
+        w = records.shape[0]
+        mesh_size = self.mesh_size
+        ppd = num_parts // mesh_size
+        cap = plan.capacity
+        F = conf.max_rounds_in_flight
+        n_chunks = math.ceil(plan.num_rounds / F)
+        total_rounds = n_chunks * F
+        pkey = getattr(partitioner, "cache_key", id(partitioner))
+
+        def cached(key, builder):
+            fn = self._exec_cache.get(key)
+            if fn is None:
+                fn = builder()
+                self._exec_cache[key] = fn
+            return fn
+
+        prep = cached(("prep", num_parts, w, pkey),
+                      lambda: self._build_prep(num_parts, w, partitioner))
+        chunk_fn = cached(("chunk", num_parts, cap, F, w),
+                          lambda: self._build_chunk(num_parts, cap, F, w))
+
+        sr, counts, offs, incoming, totals = prep(records)
+        dispatches = 1
+
+        # +cap head-room per device so fold windows never clamp
+        acc_shape = (w, mesh_size * (plan.out_capacity + cap))
+        out_sharding = NamedSharding(self.mesh, P(None, self.axis_name))
+        recv_shape = (F, mesh_size * mesh_size, ppd, w, cap)
+        # recv chunks are sharded over their *destination* axis; the
+        # global layout is [F, dest_mesh * src_mesh, ppd, W, C]
+        recv_sharding = out_sharding
+
+        def get_buf(shape, sharding):
+            if self.pool is not None:
+                return self.pool.get_shaped(shape, jnp.uint32, sharding)
+            return jax.jit(lambda: jnp.zeros(shape, jnp.uint32),
+                           out_shardings=sharding)()
+
+        acc = get_buf(acc_shape, out_sharding)
+        in_flight = []   # completion tokens of dispatched chunks
+        for j in range(n_chunks):
+            if len(in_flight) >= conf.queue_depth:
+                # the recvQueueDepth throttle: block on the oldest
+                # outstanding chunk before admitting a new one
+                jax.block_until_ready(in_flight.pop(0))
+            recv_buf = get_buf(recv_shape, recv_sharding)
+            r0 = jnp.full((1,), j * F, jnp.int32)
+            recv = chunk_fn(sr, counts, offs, r0, recv_buf)
+            fold = cached(
+                ("fold", num_parts, cap, F, total_rounds,
+                 plan.out_capacity, w, j == 0),
+                lambda: self._build_fold(num_parts, cap, F, total_rounds,
+                                         plan.out_capacity, w, j == 0))
+            cidx = jnp.full((1,), j, jnp.int32)
+            acc, token = fold(acc, recv, incoming, cidx)
+            dispatches += 2
+            in_flight.append(token)
+            if self.pool is not None:
+                # recv is consumed by the fold already enqueued; returning
+                # it now lets chunk j+1 donate the same pages (the runtime
+                # sequences the rewrite after the fold's read)
+                self.pool.put_shaped(recv, recv_sharding)
+        tail = cached(("tail", plan.out_capacity, w, sort_key_words,
+                       aggregator, float_payload),
+                      lambda: self._build_tail(
+                          plan.out_capacity, w, sort_key_words,
+                          aggregator, float_payload))
+        out, totals = tail(acc, totals)
+        dispatches += 1
+        if self.pool is not None:
+            # the accumulator is free once the (dispatched) tail read it
+            self.pool.put_shaped(acc, out_sharding)
+        self.last_dispatches = dispatches
+        return out, totals, incoming
+
+    # ------------------------------------------------------------------
+    # entry
+    # ------------------------------------------------------------------
     def exchange(
         self,
         records: jax.Array,
@@ -345,6 +642,9 @@ class ShuffleExchange:
           - ``totals``: ``int32[mesh]`` — valid record count per device;
           - ``incoming``: ``int32[mesh, mesh*ppd... ]`` flattened per-source
             counts table (observability; the received metadata).
+
+        When the exchange owns a pool, ``out`` is recycled into the next
+        same-geometry exchange (see module docstring: consume it first).
         """
         # The plan's counts matrix is the source of truth for geometry —
         # a mismatched explicit num_parts would silently drop records in
@@ -358,17 +658,55 @@ class ShuffleExchange:
         if aggregator and aggregator not in ("sum", "min", "max"):
             raise ValueError(f"unsupported aggregator {aggregator!r}")
         self._maybe_inject_fault(shuffle_id)
+        if plan.num_rounds > self.conf.max_rounds_in_flight:
+            return self._exchange_streaming(
+                records, partitioner, plan, num_parts,
+                sort_key_words, aggregator, float_payload)
         w = records.shape[0]
+        # every device's output exactly full -> the fused sort can drop
+        # its validity lead operand (static fact from the plan's counts)
+        owned = plan.counts.sum(axis=0)
+        per_dev = np.array([owned[d::self.mesh_size].sum()
+                            for d in range(self.mesh_size)])
+        tight = bool((per_dev == plan.out_capacity).all())
         key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
-               w, sort_key_words, aggregator, float_payload,
+               w, sort_key_words, aggregator, float_payload, tight,
                getattr(partitioner, "cache_key", id(partitioner)))
+        donate = self.pool is not None
         fn = self._exec_cache.get(key)
         if fn is None:
             fn = self._build_exec(num_parts, plan.capacity, plan.num_rounds,
                                   plan.out_capacity, w, partitioner,
-                                  sort_key_words, aggregator, float_payload)
+                                  sort_key_words, aggregator, float_payload,
+                                  donate_out=donate, tight_out=tight)
             self._exec_cache[key] = fn
+        self.last_dispatches = 1
+        if donate:
+            okey = (shuffle_id, key)
+            sharding = NamedSharding(self.mesh, P(None, self.axis_name))
+            prev = self._out_prev.pop(okey, None)
+            if prev is not None:
+                self.pool.put_shaped(prev[0], prev[1])
+            buf = self.pool.get_shaped(
+                (w, self.mesh_size * plan.out_capacity), jnp.uint32,
+                sharding)
+            out, totals, incoming = fn(records, buf)
+            self._out_prev[okey] = (out, sharding)
+            return out, totals, incoming
         return fn(records)
+
+    def release_shuffle(self, shuffle_id: int) -> None:
+        """Return a shuffle's recycled output buffers to the pool.
+
+        The unregisterShuffle -> dispose path: after this, the shuffle's
+        last outputs may be handed (and donated) to ANY later exchange,
+        so callers must be done consuming them.
+        """
+        if self.pool is None:
+            return
+        for okey in [k for k in self._out_prev if k[0] == shuffle_id]:
+            arr, sharding = self._out_prev.pop(okey)
+            self.pool.put_shaped(arr, sharding)
 
     def shuffle(
         self,

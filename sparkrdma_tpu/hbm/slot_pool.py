@@ -156,6 +156,60 @@ class SlotPool:
         with self._lock:
             self._free[(slot.capacity, slot.record_words)].append(slot.array)
 
+    # ------------------------------------------------------------------
+    # shaped buffers — the data path's recv-slot / output-buffer service
+    # ------------------------------------------------------------------
+    def get_shaped(self, shape: Tuple[int, ...], dtype=jnp.uint32,
+                   sharding=None) -> jax.Array:
+        """Pop (or allocate) a device buffer of an exact shape/sharding.
+
+        This is the entry the exchange data path uses: recv-slot chunks
+        and output accumulators are donated into jitted steps
+        (``donate_argnums``) so XLA reuses the HBM pages in place — the
+        registered-buffer reuse of ``RdmaBufferManager.get`` — and handed
+        back with :meth:`put_shaped` when the consumer is done. Exact
+        shapes (not size classes) because the compiled-program cache
+        already bounds the number of distinct geometries.
+        """
+        key = ("shaped", tuple(shape), jnp.dtype(dtype).name, sharding)
+        arr = None
+        with self._lock:
+            stack = self._free.get(key)
+            while stack:
+                cand = stack.pop()
+                if not cand.is_deleted():
+                    arr = cand
+                    break
+                self.donated_dropped += 1
+        if arr is None:
+            self.misses += 1
+            self.allocations += 1
+            if sharding is not None:
+                arr = jax.jit(
+                    lambda: jnp.zeros(shape, dtype),
+                    out_shardings=sharding)()
+            else:
+                arr = jnp.zeros(shape, dtype)
+                if self.device is not None:
+                    arr = jax.device_put(arr, self.device)
+        else:
+            self.hits += 1
+        return arr
+
+    def put_shaped(self, arr: jax.Array, sharding=None) -> None:
+        """Return a shaped buffer for reuse (no-op if donated/deleted).
+
+        Safe to call while enqueued computations still read ``arr``: a
+        later ``get_shaped`` that donates it into a new program is
+        sequenced after those reads by the runtime's dataflow order.
+        """
+        if arr.is_deleted():
+            self.donated_dropped += 1
+            return
+        key = ("shaped", tuple(arr.shape), arr.dtype.name, sharding)
+        with self._lock:
+            self._free[key].append(arr)
+
     def free_counts(self) -> Dict[Tuple[int, int], int]:
         with self._lock:
             return {k: len(v) for k, v in self._free.items() if v}

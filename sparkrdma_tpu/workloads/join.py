@@ -118,13 +118,16 @@ def run_hash_join(
 
     t0 = time.perf_counter()
     outs = []
+    # Both shuffles stay registered until the join consumed their outputs:
+    # unregister disposes the read buffers back to the pool (the reference
+    # frees registered buffers on unregisterShuffle), so tearing a shuffle
+    # down mid-join would let the other side's exchange recycle its pages.
     for sid, x in zip(shuffle_ids, (xa, xb)):
         handle = manager.register_shuffle(sid, mesh, part)
         writer = manager.get_writer(handle).write(rt.shard_records(x))
         writer.stop(True)
         out, totals = manager.get_reader(handle).read()
         outs.append((out, totals, writer.plan.out_capacity))
-        manager.unregister_shuffle(sid)
     barrier(outs[-1][0])
     shuffle_s = time.perf_counter() - t0
 
@@ -150,6 +153,9 @@ def run_hash_join(
     count = int(np.asarray(count)[0])
     prods = float(np.asarray(prods)[0])
     join_s = time.perf_counter() - t0
+
+    for sid in shuffle_ids:
+        manager.unregister_shuffle(sid)
 
     verified = None
     if verify:

@@ -88,6 +88,94 @@ def validate_global_sort(
     return bool(np.array_equal(canon(got), canon(x_input)))
 
 
+def device_verify_sort(
+    manager: ShuffleManager,
+    records: jax.Array,
+    out: jax.Array,
+    totals: jax.Array,
+    key_words: int,
+    out_capacity: int,
+) -> bool:
+    """Cheap large-scale invariant check, entirely on device.
+
+    Validates the three properties that make a sort a sort without the
+    O(n log n) host-side permutation check (bench scale: the host check
+    would dwarf the measured exchange):
+
+    - conservation: record count and per-word uint32 checksums of the
+      output's valid prefix match the input's;
+    - intra-device order: every device's valid prefix is lexicographically
+      non-decreasing on the key words;
+    - inter-device order: device boundaries ascend (first/last keys).
+
+    One compiled elementwise+reduction pass per side (~2 HBM reads);
+    catches dropped/duplicated/corrupted/misordered records. Not a full
+    permutation proof — pair with the host check at test scale.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sparkrdma_tpu.utils.compat import shard_map
+
+    rt = manager.runtime
+    ax = rt.axis_name
+    w = records.shape[0]
+
+    def in_sums(cols):
+        s = jnp.stack([jnp.sum(cols[i], dtype=jnp.uint32) for i in range(w)])
+        n = jnp.full((1,), cols.shape[1], jnp.int32)
+        return s[None], n
+
+    def out_checks(cols, total):
+        valid = jnp.arange(out_capacity) < total[0]
+        vu = valid.astype(jnp.uint32)
+        s = jnp.stack([jnp.sum(cols[i] * vu, dtype=jnp.uint32)
+                       for i in range(w)])
+        count = total[0]
+        # lexicographic non-decreasing over key words on the valid prefix
+        gt = jnp.zeros((out_capacity - 1,), bool)   # prev > next so far
+        eq = jnp.ones((out_capacity - 1,), bool)
+        for k in range(key_words):
+            a, b = cols[k][:-1], cols[k][1:]
+            gt = gt | (eq & (a > b))
+            eq = eq & (a == b)
+        ordered = jnp.all(~gt | ~valid[1:])
+        # boundary keys (first/last valid) for the host's cross-device check
+        first = jnp.stack([cols[k][0] for k in range(key_words)])
+        last_ix = jnp.maximum(total[0] - 1, 0)
+        last = jnp.stack([cols[k][last_ix] for k in range(key_words)])
+        return (s[None], count[None], ordered[None],
+                first[None], last[None])
+
+    in_fn = jax.jit(shard_map(in_sums, mesh=rt.mesh,
+                              in_specs=(P(None, ax),),
+                              out_specs=(P(ax), P(ax))))
+    out_fn = jax.jit(shard_map(out_checks, mesh=rt.mesh,
+                               in_specs=(P(None, ax), P(ax)),
+                               out_specs=(P(ax),) * 5))
+    s_in, n_in = map(np.asarray, in_fn(records))
+    s_out, n_out, ordered, first, last = map(np.asarray, out_fn(out, totals))
+    if int(n_in.sum()) != int(n_out.sum()):
+        return False
+    if not np.array_equal(s_in.sum(axis=0, dtype=np.uint32),
+                          s_out.sum(axis=0, dtype=np.uint32)):
+        return False
+    if not bool(ordered.all()):
+        return False
+    # device boundaries ascend (devices with 0 records are skipped)
+    tot = np.asarray(totals)
+    prev = None
+    for d in range(tot.shape[0]):
+        if tot[d] == 0:
+            continue
+        fk = int.from_bytes(first[d].astype(">u4").tobytes(), "big")
+        lk = int.from_bytes(last[d].astype(">u4").tobytes(), "big")
+        if prev is not None and fk < prev:
+            return False
+        prev = lk
+    return True
+
+
 def run_terasort(
     manager: ShuffleManager,
     records_per_device: int,
@@ -97,8 +185,18 @@ def run_terasort(
     verify: bool = True,
     warmup: bool = True,
     input_records: Optional[jax.Array] = None,
+    repeats: int = 1,
+    device_verify: bool = False,
 ) -> Tuple[TeraSortResult, jax.Array, jax.Array]:
-    """Returns ``(result, sorted_records, totals)``."""
+    """Returns ``(result, sorted_records, totals)``.
+
+    ``repeats > 1`` measures steady-state shuffle throughput: the timed
+    region re-runs the full exchange+sort ``repeats`` times back-to-back
+    (dispatches pipeline; output buffers ping-pong through the slot pool)
+    and ``sort_exchange_s`` is the per-iteration mean — amortizing
+    per-dispatch latency exactly as line-rate NIC numbers do.
+    ``device_verify`` adds the cheap on-device invariant check
+    (:func:`device_verify_sort`), usable at bench scale."""
     rt = manager.runtime
     mesh = rt.num_partitions
     kw = manager.conf.key_words
@@ -134,15 +232,22 @@ def run_terasort(
         if warmup:
             jax.block_until_ready(reader.read(record_stats=False)[0])
         t0 = time.perf_counter()
+        for _ in range(repeats - 1):
+            # steady state: each read is a complete exchange+sort; the
+            # donation chain through the pool serializes them correctly
+            reader.read(record_stats=False)
         out, totals = reader.read()
         barrier(out)
-        sort_exchange_s = time.perf_counter() - t0
+        sort_exchange_s = (time.perf_counter() - t0) / max(repeats, 1)
 
         verified = True
         if verify:
             verified = validate_global_sort(
                 np.asarray(out), np.asarray(totals), x, kw, plan.out_capacity
             )
+        if device_verify:
+            verified = verified and device_verify_sort(
+                manager, records, out, totals, kw, plan.out_capacity)
         res = TeraSortResult(
             records=n_records,
             record_bytes=rec_words * 4,
@@ -156,4 +261,5 @@ def run_terasort(
         manager.unregister_shuffle(shuffle_id)
 
 
-__all__ = ["run_terasort", "TeraSortResult", "validate_global_sort"]
+__all__ = ["run_terasort", "TeraSortResult", "validate_global_sort",
+           "device_verify_sort"]
