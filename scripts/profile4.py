@@ -1,0 +1,151 @@
+"""Round 3: targeted probes for the fast-sort design.
+
+Probes (all at N=16M, W=4, 2 key words unless noted):
+ 1. monolithic lax.sort 4op/2key          (the current hot path)
+ 2. chunked batched sort along minor dim  (VMEM-residency question)
+ 3. one bitonic compare-exchange pass cost (reshape + lexicographic minmax)
+ 4. full hierarchy: chunked sort + merge stages (big-stride passes + chunk
+    re-sort cleanup)
+ 5. 3op sort (hi, lo, iota) + 2x gather   (permutation formulation)
+
+Timing: slope method over k-chained reps (see profile2), xor-perturb between
+reps so chained reps never sort already-sorted data.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 4
+KS = (1, 3)
+
+
+def perturb(c):
+    return c ^ (c << 13) ^ (c >> 7)
+
+
+def probe(name, op, x, reperturb=True):
+    def chained(k):
+        def fn(x):
+            for i in range(k):
+                x = op(perturb(x) if (reperturb and i > 0) else x)
+            return x
+        return jax.jit(fn)
+
+    times = []
+    for k in KS:
+        fn = chained(k)
+        out = fn(x)
+        barrier(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(x)
+            barrier(out)
+            ts.append(time.perf_counter() - t0)
+        times.append(min(ts))
+    slope = (times[-1] - times[0]) / (KS[-1] - KS[0])
+    print(f"{name:46s} " + " ".join(f"{t*1e3:8.1f}ms" for t in times) +
+          f"  | per-op {slope*1e3:8.2f} ms", flush=True)
+    return slope
+
+
+def lex_lt(ka, la, kb, lb):
+    """(ka,la) < (kb,lb) lexicographically, uint32 words."""
+    return (ka < kb) | ((ka == kb) & (la < lb))
+
+
+def merge_pass(c, stride):
+    """One bitonic compare-exchange pass over columnar [W, N]: compare
+    elements i and i+stride within blocks of 2*stride; keep min/max by
+    2-word lexicographic key; payload words follow their key."""
+    w, n = c.shape
+    blocks = n // (2 * stride)
+    x = c.reshape(w, blocks, 2, stride)
+    a, b = x[:, :, 0, :], x[:, :, 1, :]
+    swap = ~lex_lt(a[0], a[1], b[0], b[1])
+    lo = jnp.where(swap, b, a)
+    hi = jnp.where(swap, a, b)
+    return jnp.stack([lo, hi], axis=2).reshape(w, n)
+
+
+def chunk_sort(c, L):
+    """Batched sort of contiguous chunks of length L along minor dim."""
+    w, n = c.shape
+    m = n // L
+    x = c.reshape(w, m, L)
+    out = lax.sort(tuple(x[i] for i in range(w)), num_keys=2,
+                   is_stable=True, dimension=1)
+    return jnp.stack(out).reshape(w, n)
+
+
+def hier_sort(c, L):
+    """Chunked sort + hierarchical bitonic merge.
+
+    To merge pairs of sorted runs with the classic bitonic network the
+    second run must be reversed; equivalently flip odd runs, then run
+    strides run_len..1. Strides < L are finished with one batched chunk
+    cleanup... but a plain lax.sort per chunk is correct regardless, so:
+    per merge stage with run length R: flip odd runs, passes for strides
+    R..L (reshape minmax), then chunk_sort(L) to finish strides < L.
+    """
+    w, n = c.shape
+    c = chunk_sort(c, L)
+    run = L
+    while run < n:
+        # flip odd runs: [w, n] -> [w, n/(2run), 2, run]; reverse 2nd run
+        x = c.reshape(w, n // (2 * run), 2, run)
+        x = x.at[:, :, 1, :].set(x[:, :, 1, ::-1])
+        c = x.reshape(w, n)
+        stride = run
+        while stride >= L:
+            c = merge_pass(c, stride)
+            stride //= 2
+        c = chunk_sort(c, L)
+        run *= 2
+    return c
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N}", flush=True)
+    rng = np.random.default_rng(0)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    def sort4(c):
+        out = lax.sort(tuple(c[i] for i in range(W)), num_keys=2,
+                       is_stable=True)
+        return jnp.stack(out)
+    probe("monolithic 4op 2key", sort4, cols)
+
+    for L in (1 << 15, 1 << 17, 1 << 19):
+        probe(f"chunk_sort L={L}", lambda c, L=L: chunk_sort(c, L), cols)
+
+    probe("one merge_pass stride=N/2",
+          lambda c: merge_pass(c, N // 2), cols)
+
+    for L in (1 << 15, 1 << 17, 1 << 19):
+        probe(f"hier_sort L={L}", lambda c, L=L: hier_sort(c, L), cols)
+
+    def sort_iota_gather(c):
+        idx = lax.iota(jnp.uint32, N)
+        out = lax.sort((c[0], c[1], idx), num_keys=2, is_stable=True)
+        perm = out[2]
+        pay = jnp.take(c[2:], perm, axis=1)
+        return jnp.concatenate([jnp.stack(out[:2]), pay])
+    probe("3op sort + payload gather", sort_iota_gather, cols)
+
+
+if __name__ == "__main__":
+    main()

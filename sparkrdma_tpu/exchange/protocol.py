@@ -67,7 +67,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkrdma_tpu.config import ShuffleConf, size_class
-from sparkrdma_tpu.kernels.bucketing import (bucket_records, compact_segments,
+from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
+                                             compact_segments,
                                              fill_round_slots)
 
 from sparkrdma_tpu.utils.compat import shard_map
@@ -482,21 +483,46 @@ class ShuffleExchange:
             ).reshape(ppd, mesh_size, total_rounds)
             col = jnp.arange(cap, dtype=jnp.int32)[None, :]
             if first:
-                acc = jnp.zeros_like(acc)
-            for q in range(ppd):
-                for s in range(mesh_size):
-                    for j in range(rounds_per):
-                        r = cidx[0] * rounds_per + j
-                        seg = recv[j, s, q]            # [W, C]
-                        ln = jnp.clip(inc[s, q] - r * cap, 0, cap)
-                        dst = jnp.where(
-                            r < total_rounds,
-                            starts[q, s, jnp.minimum(r, total_rounds - 1)],
-                            acc.shape[1] - cap)  # parked write, len 0
-                        window = lax.dynamic_slice(acc, (0, dst), (w, cap))
-                        blended = jnp.where(col < ln, seg, window)
-                        acc = lax.dynamic_update_slice(acc, blended,
-                                                       (0, dst))
+                # data-dependent zeroing (not zeros_like) keeps acc's
+                # varying-manual-axes type intact for the fori_loop carry
+                # and lets XLA alias the donated pages
+                acc = acc & jnp.uint32(0)
+
+            # One blend-write per (q, s, j) segment. Small geometries
+            # unroll statically (constant-folded indices, the hot default
+            # path); large ones use a device loop so program size is O(1)
+            # in mesh size (round 1+2 advisors both flagged the unrolled
+            # form: ppd*mesh*rounds_per serialized bodies per chunk
+            # program). The writes are serially dependent either way —
+            # neighbouring segments share window columns.
+            zero = jnp.zeros((), jnp.int32)
+            n_segs = ppd * mesh_size * rounds_per
+
+            def blend_one(t, acc):
+                q = t // (mesh_size * rounds_per)
+                rem = t % (mesh_size * rounds_per)
+                s = rem // rounds_per
+                j = rem % rounds_per
+                r = cidx[0] * rounds_per + j
+                seg = lax.dynamic_slice(
+                    recv, (j, s, q, zero, zero), (1, 1, 1, w, cap)
+                ).reshape(w, cap)
+                inc_sq = lax.dynamic_slice(inc, (s, q), (1, 1))[0, 0]
+                ln = jnp.clip(inc_sq - r * cap, 0, cap)
+                rc = jnp.minimum(r, total_rounds - 1)
+                start_qsr = lax.dynamic_slice(
+                    starts, (q, s, rc), (1, 1, 1))[0, 0, 0]
+                dst = jnp.where(r < total_rounds, start_qsr,
+                                acc.shape[1] - cap)  # parked write, len 0
+                window = lax.dynamic_slice(acc, (0, dst), (w, cap))
+                blended = jnp.where(col < ln, seg, window)
+                return lax.dynamic_update_slice(acc, blended, (0, dst))
+
+            if n_segs <= _UNROLL_LIMIT:
+                for t in range(n_segs):
+                    acc = blend_one(jnp.int32(t), acc)
+            else:
+                acc = lax.fori_loop(0, n_segs, blend_one, acc)
             # tiny completion token: an undonated output the host can
             # block on for in-flight pacing (acc itself is donated into
             # the NEXT fold, so its handle dies before the host would

@@ -38,6 +38,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+#: Above this many serially-dependent copies, emit a device loop instead of
+#: unrolling — keeps program size O(1) in partition/segment count.
+_UNROLL_LIMIT = 16
+
 
 def bucket_records(
     records: jax.Array, part_ids: jax.Array, num_parts: int
@@ -87,6 +91,10 @@ def fill_round_slots(
 
     ``num_parts`` contiguous window reads per column at HBM bandwidth —
     a per-row gather of narrow records would use W of the VPU's 128 lanes.
+    Small partition counts unroll statically; large ones use a
+    ``lax.scan`` so program size stays O(1) in ``num_parts`` (the copies
+    are serially dependent either way — a repartition(256) geometry must
+    not produce a 256-body program).
     """
     w, n = bucketed.shape
     round_idx = jnp.asarray(round_idx, jnp.int32)
@@ -97,11 +105,21 @@ def fill_round_slots(
     # pad so every window is in-bounds (dynamic_slice clamps otherwise,
     # which would silently shift a window into the previous bucket)
     padded = jnp.concatenate([bucketed, pad], axis=1)     # [W, N+C]
-    windows = []
-    for p in range(num_parts):  # static unroll: P contiguous copies
-        start = offsets[p] + round_idx * capacity
-        windows.append(lax.dynamic_slice(padded, (0, start), (w, capacity)))
-    slots = jnp.stack(windows, axis=1)                    # [W, P, C]
+    if num_parts <= _UNROLL_LIMIT:
+        windows = []
+        for p in range(num_parts):  # static unroll: P contiguous copies
+            start = offsets[p] + round_idx * capacity
+            windows.append(
+                lax.dynamic_slice(padded, (0, start), (w, capacity)))
+        slots = jnp.stack(windows, axis=1)                # [W, P, C]
+    else:
+        def window(_, p):
+            start = offsets[p] + round_idx * capacity
+            return None, lax.dynamic_slice(padded, (0, start),
+                                           (w, capacity))
+        _, wins = lax.scan(window, None,
+                           jnp.arange(num_parts, dtype=jnp.int32))
+        slots = wins.transpose(1, 0, 2)                   # [W, P, C]
     slots = slots * valid[None].astype(slots.dtype)
     return slots, send_counts.astype(jnp.int32)
 
@@ -130,12 +148,23 @@ def compact_segments(
                            jnp.cumsum(seg_counts).astype(jnp.int32)])
     total = cum[-1]
     # +C headroom so the last write never clamps (clamping would shift the
-    # window backward over valid data)
-    out = jnp.zeros((w, out_capacity + c), stream.dtype)
-    for i in range(s):  # ascending: later segments repair earlier tails
+    # window backward over valid data). The zero is derived from the data
+    # so the loop carry's varying-manual-axes type matches the body output
+    # under shard_map (a constant init would be unvarying -> fori_loop
+    # carry type error).
+    vzero = stream[0, 0] & stream.dtype.type(0)
+    out = jnp.zeros((w, out_capacity + c), stream.dtype) + vzero
+
+    def copy_seg(i, out):  # ascending: later segments repair earlier tails
         seg = lax.dynamic_slice(stream, (0, i * c), (w, c))
         dst = jnp.minimum(cum[i], out_capacity)
-        out = lax.dynamic_update_slice(out, seg, (0, dst))
+        return lax.dynamic_update_slice(out, seg, (0, dst))
+
+    if s <= _UNROLL_LIMIT:
+        for i in range(s):
+            out = copy_seg(i, out)
+    else:
+        out = lax.fori_loop(0, s, copy_seg, out)
     packed = out[:, :out_capacity]
     valid = jnp.arange(out_capacity, dtype=jnp.int32) < total
     packed = packed * valid[None, :].astype(packed.dtype)

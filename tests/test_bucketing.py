@@ -91,3 +91,36 @@ def test_compact_segments_overflow_reported(rng):
     packed, total = compact_segments(_cols(stream), jnp.asarray(counts), 6)
     assert int(total) == 8  # true count exceeds capacity -> caller detects
     assert packed.shape == (2, 6)
+
+
+def test_fill_round_slots_program_size_flat_in_parts(rng):
+    """Deterministic O(1)-program-size guard: the lowered text of the
+    slot-fill must not grow with partition count once past the unroll
+    limit (the repartition(256) scaling fix — an unrolled form would be
+    ~4x larger at 4x the partitions)."""
+    import jax
+
+    def lowered_len(p):
+        n, cap, w = 1024, 8, 4
+        fn = jax.jit(lambda b, c, o: fill_round_slots(b, c, o, p, cap, 0))
+        args = (jax.ShapeDtypeStruct((w, n), jnp.uint32),
+                jax.ShapeDtypeStruct((p,), jnp.int32),
+                jax.ShapeDtypeStruct((p,), jnp.int32))
+        return len(fn.lower(*args).as_text())
+
+    l64, l256 = lowered_len(64), lowered_len(256)
+    assert l256 < 1.5 * l64, (l64, l256)
+
+
+def test_compact_segments_program_size_flat_in_segments(rng):
+    import jax
+
+    def lowered_len(s):
+        c, w = 8, 4
+        fn = jax.jit(lambda st, sc: compact_segments(st, sc, 64))
+        args = (jax.ShapeDtypeStruct((w, s * c), jnp.uint32),
+                jax.ShapeDtypeStruct((s,), jnp.int32))
+        return len(fn.lower(*args).as_text())
+
+    l64, l256 = lowered_len(64), lowered_len(256)
+    assert l256 < 1.5 * l64, (l64, l256)

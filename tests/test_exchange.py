@@ -140,6 +140,45 @@ def test_plan_rejects_excessive_skew(exchange, rng):
         ex2.plan(xg, modulo_partitioner(8))
 
 
+def test_repartition_256_geometry(exchange, rng):
+    """BASELINE config 1's geometry: 256 partitions on the 8-chip mesh
+    (32 partitions per device), both regimes.
+
+    This is the scaling guard for the loop-form kernels: with 256
+    partitions the map side must emit a ``lax.scan`` (not 256 unrolled
+    slices per round) and the streaming fold a ``fori_loop`` (not
+    ppd*mesh*rounds unrolled blend-writes). Content is checked against
+    the numpy reference in BOTH regimes (the fold's index decomposition
+    has no other ppd>1 content coverage); program-size scaling is pinned
+    deterministically in test_bucketing.test_fill_round_slots_program_size.
+    """
+    _, rt = exchange
+    xg, xn = make_global_records(rng, rt, 512)
+    part = hash_partitioner(256)
+    plan = run_and_check(exchange, xg, xn, part, 256, rng)
+    assert plan.num_rounds == 1  # balanced: auto-sized capacity, one round
+
+    # streaming regime at the same partition count: small explicit slots
+    # force multiple rounds through the chunk/fold path (fori_loop fold
+    # at ppd=32); full golden content check, not just conservation
+    conf = ShuffleConf(slot_records=2, max_rounds=16, max_rounds_in_flight=1)
+    ex2 = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+    plan2 = ex2.plan(xg, part, num_parts=256, capacity=2)
+    assert plan2.num_rounds > 1
+    out2, tot2, _ = ex2.exchange(xg, part, plan2)
+    pids = np.asarray(part(jnp.asarray(xn.T)))
+    n_per_dev = xn.shape[0] // rt.num_partitions
+    ref = np_reference_shuffle(xn, pids, 256, rt.num_partitions, n_per_dev)
+    out_np, tot_np = np.asarray(out2), np.asarray(tot2)
+    cap = plan2.out_capacity
+    for d in range(rt.num_partitions):
+        k = int(tot_np[d])
+        assert k == len(ref[d])
+        np.testing.assert_array_equal(
+            out_np[:, d * cap:d * cap + k].T, ref[d])
+    assert tot_np.sum() == xn.shape[0]
+
+
 def test_exchange_program_cache_reused(exchange, rng):
     ex, rt = exchange
     xg, xn = make_global_records(rng, rt, 32)
