@@ -1,0 +1,146 @@
+"""Round 3 follow-up probes.
+
+ a) Do SEPARATE dispatches pipeline over the axon tunnel? (k dispatches of
+    the same program back-to-back + one barrier vs k chained in-program.)
+ b) random gather cost at 16M (payload-permutation formulation)
+ c) random scatter cost at 16M (radix-distribution formulation)
+ d) merge_pass cost, measured with deeper chains
+ e) chunk_sort sweep incl. small L
+ f) operand-count scaling: 2op/1key vs 4op/2key monolithic
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 4
+
+
+def perturb(c):
+    return c ^ (c << 13) ^ (c >> 7)
+
+
+def probe(name, op, x, ks=(1, 3), reperturb=True):
+    def chained(k):
+        def fn(x):
+            for i in range(k):
+                x = op(perturb(x) if (reperturb and i > 0) else x)
+            return x
+        return jax.jit(fn)
+
+    times = []
+    for k in ks:
+        fn = chained(k)
+        out = fn(x)
+        barrier(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(x)
+            barrier(out)
+            ts.append(time.perf_counter() - t0)
+        times.append(min(ts))
+    slope = (times[-1] - times[0]) / (ks[-1] - ks[0])
+    print(f"{name:46s} " + " ".join(f"{t*1e3:8.1f}ms" for t in times) +
+          f"  | per-op {slope*1e3:8.2f} ms", flush=True)
+    return slope
+
+
+def lex_lt(ka, la, kb, lb):
+    return (ka < kb) | ((ka == kb) & (la < lb))
+
+
+def merge_pass(c, stride):
+    w, n = c.shape
+    blocks = n // (2 * stride)
+    x = c.reshape(w, blocks, 2, stride)
+    a, b = x[:, :, 0, :], x[:, :, 1, :]
+    swap = ~lex_lt(a[0], a[1], b[0], b[1])
+    lo = jnp.where(swap, b, a)
+    hi = jnp.where(swap, a, b)
+    return jnp.stack([lo, hi], axis=2).reshape(w, n)
+
+
+def chunk_sort(c, L):
+    w, n = c.shape
+    m = n // L
+    x = c.reshape(w, m, L)
+    out = lax.sort(tuple(x[i] for i in range(w)), num_keys=2,
+                   is_stable=True, dimension=1)
+    return jnp.stack(out).reshape(w, n)
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N}", flush=True)
+    rng = np.random.default_rng(0)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    # (a) dispatch pipelining: one compiled sort, dispatched k times
+    def sort4(c):
+        out = lax.sort(tuple(c[i] for i in range(W)), num_keys=2,
+                       is_stable=True)
+        return jnp.stack(out)
+    fn = jax.jit(lambda c: sort4(perturb(c)))
+    out = fn(cols)
+    barrier(out)
+    for k in (1, 2, 4, 8):
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            x = cols
+            for _ in range(k):
+                x = fn(x)
+            barrier(x)
+            ts.append(time.perf_counter() - t0)
+        t = min(ts)
+        print(f"separate dispatches k={k}: total {t*1e3:8.1f}ms  "
+              f"per-iter {t/k*1e3:8.1f}ms", flush=True)
+
+    # (b) gather: permute 1 and 2 columns by a random permutation
+    perm = jax.device_put(rng.permutation(N).astype(np.int32))
+    barrier(perm)
+    probe("gather 1 col by perm",
+          lambda c: jnp.take(c[2], perm, axis=0)[None].astype(jnp.uint32)
+          * jnp.uint32(1) + c * jnp.uint32(0),
+          cols, reperturb=False)
+    probe("gather 2 cols by perm",
+          lambda c: jnp.concatenate(
+              [c[:2], jnp.take(c[2:], perm, axis=1)]),
+          cols, reperturb=False)
+
+    # (c) scatter 4 cols to a random permutation of positions
+    def scat(c):
+        return jnp.zeros_like(c).at[:, perm].set(c)
+    probe("scatter 4 cols by perm", scat, cols, reperturb=False)
+
+    # (d) merge_pass with deeper chains (less dispatch noise)
+    probe("merge_pass stride=N/2 (deep)",
+          lambda c: merge_pass(c, N // 2), cols, ks=(2, 8))
+    probe("merge_pass stride=4096 (deep)",
+          lambda c: merge_pass(c, 4096), cols, ks=(2, 8))
+
+    # (e) chunk_sort sweep
+    for L in (1 << 13, 1 << 14, 1 << 16):
+        probe(f"chunk_sort L={L}", lambda c, L=L: chunk_sort(c, L), cols)
+
+    # (f) operand scaling
+    def sort2(c):
+        out = lax.sort((c[0], c[1]), num_keys=1, is_stable=True)
+        return jnp.stack(out + (c[2], c[3]))
+    probe("monolithic 2op 1key", sort2, cols)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Decompose the real bench-geometry program: where do 250ms go?
+
+Runs the exact bench pipeline (1 real chip, 16M records) via the public
+API, timing steady-state reads with and without the fused sort, and the
+planning step. Slope method over chained reads.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.workloads.terasort import run_terasort
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+
+
+def timed_reads(reader, k):
+    for _ in range(k - 1):
+        reader.read(record_stats=False)
+    out, _ = reader.read(record_stats=False)
+    barrier(out)
+
+
+def steady(reader, k=8):
+    timed_reads(reader, 2)      # warm
+    ts = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        timed_reads(reader, k)
+        ts.append((time.perf_counter() - t0) / k)
+    return min(ts)
+
+
+def main():
+    mesh_size = len(jax.devices())
+    slot = max(4096, N)
+    conf = ShuffleConf(slot_records=slot, max_rounds=64,
+                       max_slot_records=max(1 << 22, 2 * slot),
+                       collect_shuffle_read_stats=False)
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+    from sparkrdma_tpu.exchange.partitioners import range_partitioner
+
+    rt = manager.runtime
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2**32, size=(mesh_size * N, 4), dtype=np.uint32)
+    records = rt.shard_records(x)
+    barrier(records)
+
+    sampler = make_sampler(rt.mesh, rt.axis_name, 2, 256)
+    samples = np.asarray(jax.device_get(sampler(records)))
+    splitters = compute_splitters(samples, mesh_size)
+    part = range_partitioner(splitters, 2)
+    handle = manager.register_shuffle(0, mesh_size, part)
+    w = manager.get_writer(handle).write(records)
+    t0 = time.perf_counter()
+    plan = w.stop(True)
+    print(f"plan: {time.perf_counter()-t0:.3f}s rounds={plan.num_rounds} "
+          f"out_capacity={plan.out_capacity}", flush=True)
+
+    r_nosort = manager.get_reader(handle)
+    t = steady(r_nosort)
+    print(f"steady read, NO sort:   {t*1e3:8.1f} ms/iter", flush=True)
+
+    r_sort = manager.get_reader(handle, key_ordering=True)
+    t = steady(r_sort)
+    print(f"steady read, fused sort:{t*1e3:8.1f} ms/iter", flush=True)
+
+    manager.unregister_shuffle(0)
+    manager.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,12 @@ class ShuffleReader:
 
         ``record_stats=False`` suppresses the stats record (used for
         warmup/compile passes so throughput histograms stay honest).
+        CONTRACT: it also skips the hard device sync, so an async backend
+        failure from such a read surfaces later — at the caller's first
+        sync — OUTSIDE this method's FetchFailed/retry wrap. Un-recorded
+        reads trade retry protection for dispatch pipelining; issue a
+        final ``record_stats=True`` read (as the bench loop does) or
+        sync and handle ``jax.errors.JaxRuntimeError`` yourself.
         """
         writer = self._m._recover_writer(self._h)
         ex = self._m._exchange
@@ -180,6 +186,13 @@ class ShuffleReader:
                 # a statement about exchange throughput.
                 filtered = (self.start_partition, self.end_partition) != (
                     0, self._h.num_parts)
+                if filtered and writer.plan.split_factor > 1:
+                    raise ValueError(
+                        "partition-range reads are not supported on a "
+                        "skew-split shuffle (records of one partition "
+                        "are spread over sub-partitions); read the full "
+                        "range or raise slot_records/max_rounds to avoid "
+                        "splitting")
                 # Full-range reads fuse sort/aggregation into the
                 # exchange program (one dispatch); a partition filter
                 # must apply first, so those stay separate programs there.
@@ -273,6 +286,11 @@ class ShuffleReader:
                 f"partition {partition} outside reader range "
                 f"[{self.start_partition}, {self.end_partition})"
             )
+        pre_plan = self._m._recover_writer(self._h).plan
+        if pre_plan is not None and pre_plan.split_factor > 1:
+            # check BEFORE dispatching the (large, skewed) full exchange
+            raise ValueError(
+                "read_partition is not supported on a skew-split shuffle")
         # Segment offsets below assume the unsorted (local partition,
         # source) layout, so read without key ordering even if this
         # reader sorts — per-partition slices are cut from the raw layout.

@@ -81,16 +81,51 @@ class ShufflePlan:
     ``counts[s, p]`` = records device ``s`` will send to partition ``p``
     (the global RdmaMapTaskOutput table). ``num_rounds`` and
     ``out_capacity`` are the static geometry derived from it.
+
+    ``split_factor > 1`` records hot-partition splitting (SURVEY.md §7
+    hard-part 2): every partition was split into that many position-based
+    sub-partitions owned by the SAME device, so ``counts`` has
+    ``num_parts * split_factor`` columns. Records of an original
+    partition stay on their device but are no longer contiguous in its
+    output stream (they appear once per sub-partition) — full-range
+    reads (sort/aggregate/repartition) are unaffected; partition-range
+    views refuse split plans.
     """
 
-    counts: np.ndarray          # int64 [mesh, num_parts]
+    counts: np.ndarray          # int64 [mesh, num_parts * split_factor]
     num_rounds: int
     out_capacity: int           # per-device compacted output capacity
     capacity: int               # slot capacity used for planning
+    split_factor: int = 1
 
     @property
     def total_records(self) -> int:
         return int(self.counts.sum())
+
+
+def split_partitioner(partitioner: Callable, num_parts: int,
+                      k: int) -> Callable:
+    """Wrap ``partitioner`` to spread each partition over ``k``
+    same-device sub-partitions ``p + num_parts * j``.
+
+    ``j`` cycles by record position (``iota % k``): deterministic across
+    the plan's count pass and the exchange's bucket pass (both see the
+    same per-device layout), uniform even when every key is identical —
+    the failure mode key-hash splitting cannot handle. Because
+    ``num_parts`` is a multiple of the mesh size, ``(p + num_parts*j) %
+    mesh == p % mesh``: ownership is unchanged, only the per-(src, dst)
+    round pressure drops by ~k (Spark gets this relief from
+    many-tasks-per-core; AQE-style skew splitting is the same move).
+    """
+
+    def wrapped(records):
+        base = partitioner(records).astype(jnp.int32)
+        j = lax.iota(jnp.int32, records.shape[1]) % k
+        return base + num_parts * j
+
+    wrapped.cache_key = ("split", k, num_parts,
+                         getattr(partitioner, "cache_key", id(partitioner)))
+    return wrapped
 
 
 def _device_partition_counts(counts_local, num_parts, mesh_size, axis_name):
@@ -200,38 +235,56 @@ class ShuffleExchange:
         """
         num_parts = num_parts or self.mesh_size
         explicit_capacity = capacity
-        capacity = capacity or self.conf.slot_records
         if num_parts % self.mesh_size:
             raise ValueError(
                 f"num_parts {num_parts} not a multiple of mesh size "
                 f"{self.mesh_size}"
             )
-        key = (num_parts, getattr(partitioner, "cache_key", id(partitioner)))
-        fn = self._count_cache.get(key)
-        if fn is None:
-            fn = _make_count_fn(self.mesh, self.axis_name, num_parts,
-                                partitioner)
-            self._count_cache[key] = fn
-        counts = np.asarray(jax.device_get(fn(records))).astype(np.int64)
-        per_pair_max = int(counts.max(initial=0))
-        if explicit_capacity is None:
-            # Auto-size the slot to the measured worst (src, dst) pair,
-            # capped by conf.slot_records (the maxAggBlock ceiling): a
-            # balanced shuffle then pads almost nothing, while skew
-            # streams in slot_records-sized rounds. Power-of-two classes
-            # bound the number of compiled geometries (same rule as the
-            # buffer pools).
-            capacity = min(size_class(max(1, per_pair_max)),
-                           self.conf.slot_records)
-        num_rounds = max(1, math.ceil(per_pair_max / capacity))
+
+        def measure(part_fn, parts):
+            key = (parts, getattr(part_fn, "cache_key", id(part_fn)))
+            fn = self._count_cache.get(key)
+            if fn is None:
+                fn = _make_count_fn(self.mesh, self.axis_name, parts,
+                                    part_fn)
+                self._count_cache[key] = fn
+            counts = np.asarray(jax.device_get(fn(records))).astype(np.int64)
+            per_pair_max = int(counts.max(initial=0))
+            if explicit_capacity is not None:
+                cap = explicit_capacity
+            else:
+                # Auto-size the slot to the measured worst (src, dst)
+                # pair, capped by conf.slot_records (the maxAggBlock
+                # ceiling): a balanced shuffle then pads almost nothing,
+                # while skew streams in slot_records-sized rounds.
+                # Power-of-two classes bound the number of compiled
+                # geometries (same rule as the buffer pools).
+                cap = min(size_class(max(1, per_pair_max)),
+                          self.conf.slot_records)
+            return counts, cap, max(1, math.ceil(per_pair_max / cap))
+
+        counts, capacity, num_rounds = measure(partitioner, num_parts)
+        split = 1
         if num_rounds > self.conf.max_rounds:
+            # Hot-partition mitigation (SURVEY.md §7 hard-part 2): split
+            # every partition into k same-device sub-partitions so the
+            # worst (src, dst) pair shrinks by ~k, instead of refusing.
+            split = math.ceil(num_rounds / self.conf.max_rounds)
+            sp = split_partitioner(partitioner, num_parts, split)
+            counts, capacity, num_rounds = measure(sp, num_parts * split)
+        if num_rounds > self.conf.max_rounds:
+            # defensive only: position-based splitting is uniform per
+            # (src, partition), so the re-measured rounds land within the
+            # budget for any input (covered by the extreme-skew test);
+            # kept as a guard against future non-uniform split schemes
             raise ValueError(
                 f"partition skew needs {num_rounds} rounds > max_rounds "
-                f"{self.conf.max_rounds}; raise slot_records or max_rounds"
+                f"{self.conf.max_rounds} even after {split}-way partition "
+                "splitting; raise slot_records or max_rounds"
             )
         # records received by device d = sum over sources of counts[:, p]
         # for the partitions p owned by d (p % mesh == d)
-        owned = counts.sum(axis=0)  # [num_parts]
+        owned = counts.sum(axis=0)  # [num_parts * split]
         per_device_in = np.array(
             [owned[d::self.mesh_size].sum() for d in range(self.mesh_size)]
         )
@@ -241,6 +294,7 @@ class ShuffleExchange:
             num_rounds=num_rounds,
             out_capacity=out_capacity,
             capacity=capacity,
+            split_factor=split,
         )
 
     # ------------------------------------------------------------------
@@ -597,8 +651,16 @@ class ShuffleExchange:
         def get_buf(shape, sharding):
             if self.pool is not None:
                 return self.pool.get_shaped(shape, jnp.uint32, sharding)
-            return jax.jit(lambda: jnp.zeros(shape, jnp.uint32),
-                           out_shardings=sharding)()
+            # pool-less fallback: cache the compiled zero-alloc per
+            # geometry (a fresh jit per call would recompile once per
+            # chunk per exchange — round-2 advisor finding)
+            zkey = ("zeros", shape, sharding)
+            zfn = self._exec_cache.get(zkey)
+            if zfn is None:
+                zfn = jax.jit(lambda: jnp.zeros(shape, jnp.uint32),
+                              out_shardings=sharding)
+                self._exec_cache[zkey] = zfn
+            return zfn()
 
         acc = get_buf(acc_shape, out_sharding)
         in_flight = []   # completion tokens of dispatched chunks
@@ -676,11 +738,19 @@ class ShuffleExchange:
         # a mismatched explicit num_parts would silently drop records in
         # bucket_records' fixed-length bincount.
         plan_parts = int(plan.counts.shape[1])
-        if num_parts is not None and num_parts != plan_parts:
+        if (num_parts is not None
+                and num_parts * plan.split_factor != plan_parts):
             raise ValueError(
-                f"num_parts {num_parts} != plan's {plan_parts}"
+                f"num_parts {num_parts} != plan's {plan_parts} "
+                f"(split_factor {plan.split_factor})"
             )
         num_parts = plan_parts
+        if plan.split_factor > 1:
+            # identical wrapping to the plan's count pass (same iota
+            # cycling, same cache_key) — bucketing must agree with counts
+            partitioner = split_partitioner(
+                partitioner, plan_parts // plan.split_factor,
+                plan.split_factor)
         if aggregator and aggregator not in ("sum", "min", "max"):
             raise ValueError(f"unsupported aggregator {aggregator!r}")
         self._maybe_inject_fault(shuffle_id)

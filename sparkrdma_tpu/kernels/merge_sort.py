@@ -1,0 +1,355 @@
+"""Pallas merge-path sort — the fast device sort for large record batches.
+
+SURVEY.md §7 hard-part 3 ("sort-merge in HBM at line rate") and the round-2
+verdict's top task. The reference hands reduce-side key ordering to Spark's
+``ExternalSorter`` (a disk-backed merge sort); here the analogous component
+is a TPU-native two-phase sort over columnar records ``uint32[W, N]``:
+
+1. **Run formation** (XLA): one batched ``lax.sort`` over contiguous
+   chunks of ``L0`` records. XLA keeps each chunk VMEM-resident, so this
+   costs ~1 HBM read+write plus the in-VMEM network — measured ~5x faster
+   per byte than a monolithic ``lax.sort`` at 16M records
+   (scripts/profile4.py: 15.8ms vs 77ms chunked@32K).
+2. **Merge stages** (Pallas): ``log2(N/L0)`` stages; stage ``s`` merges
+   pairs of sorted runs of length ``R`` into runs of ``2R``. Each stage is
+   ONE kernel pass over the array: for every output tile of ``T`` records,
+   the host-precomputed *merge-path diagonal* (binary search on device,
+   vectorized in XLA) gives the exact split ``(a, b)`` of the tile's
+   sources; the kernel DMAs the two candidate windows ``A[a:a+T]`` and
+   ``B[b:b+T]`` into VMEM, bitonic-merges them (both are sorted; reversed
+   concatenation is bitonic), and writes the first ``T`` — a linear merge
+   at HBM bandwidth instead of ``lax.sort``'s O(log^2) global passes.
+
+Records compare lexicographically over ALL ``W`` words (keys lead, payload
+words break ties). Total order up to identical records makes every
+merge-path split multiset-exact — no stability bookkeeping is needed, and
+the result is still "sorted by the key words". Callers that need
+equal-key arrival order preserved must use the stable ``lexsort_cols``.
+
+Padding handling: rows with ``valid == False`` are lifted to all-ones
+(0xFFFFFFFF...) so they sort to the tail as a block, then zeroed back
+after the sort — the same contract as ``lexsort_cols``'s validity lead.
+
+The kernel runs compiled on TPU and in interpret mode on CPU (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_FULL = np.uint32(0xFFFFFFFF)   # numpy scalar: kernels may close over it
+
+
+def _lex_lt(a_words, b_words):
+    """Lexicographic a < b over aligned word lists (uint32)."""
+    lt = jnp.zeros(a_words[0].shape, bool)
+    eq = jnp.ones(a_words[0].shape, bool)
+    for a, b in zip(a_words, b_words):
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    return lt
+
+
+_LANES = 128   # TPU vector lane width: reshapes must keep a >=128 minor dim
+
+
+def _xor_partner_grouped(g, s):
+    """``out[.., j] = g[.., j XOR s]`` per 128-lane group, for
+    power-of-two ``s < _LANES``; ``g: [.., groups, 128]``.
+
+    Mosaic cannot reshape below the 128-lane minor dimension, so
+    sub-lane partner exchange is done with two per-lane-group rolls and
+    a parity select: for lanes with bit ``s`` clear the partner is ``j +
+    s`` (the up-roll), else ``j - s`` (the down-roll). ``j XOR s`` never
+    leaves its 128-lane group, so group-cyclic rolls are exact.
+    """
+    up = pltpu.roll(g, shift=_LANES - s, axis=g.ndim - 1)
+    down = pltpu.roll(g, shift=s, axis=g.ndim - 1)
+    lane = lax.broadcasted_iota(jnp.int32, g.shape, g.ndim - 1)
+    return jnp.where((lane & s) == 0, up, down)
+
+
+def _reverse_cols(cols, length):
+    """Reverse ``cols: [W, length]`` along the record axis without
+    ``rev`` (no Mosaic lowering): reversal = ``i -> i XOR (length-1)``,
+    composed from one unconditional partner-swap per bit — reshape/stack
+    half-swaps for scales >= 128, lane-group rolls below."""
+    w = cols.shape[0]
+    size = length
+    blocks = 1
+    while size > 1:
+        half = size // 2
+        if half >= _LANES:
+            y = cols.reshape(w, blocks, 2, half)
+            cols = jnp.stack([y[:, :, 1, :], y[:, :, 0, :]],
+                             axis=2).reshape(w, length)
+        else:
+            g = cols.reshape(w, length // _LANES, _LANES)
+            cols = _xor_partner_grouped(g, half).reshape(w, length)
+        blocks *= 2
+        size = half
+    return cols
+
+
+def _bitonic_merge_cols(cols, length):
+    """Merge a bitonic sequence ``cols: [W, length]`` ascending in VMEM.
+
+    ``length`` must be a power of two. Full-record comparator: the swap
+    decision uses all W words; all W words move together. Strides >=
+    128 use reshape-pair compare-exchange; smaller strides exchange
+    partners via lane rolls (Mosaic reshape limit).
+    """
+    w = cols.shape[0]
+    stride = length // 2
+    while stride >= _LANES:
+        blocks = length // (2 * stride)
+        x = cols.reshape(w, blocks, 2, stride)
+        a, b = x[:, :, 0, :], x[:, :, 1, :]
+        swap = _lex_lt([b[i] for i in range(w)], [a[i] for i in range(w)])
+        lo = jnp.where(swap, b, a)
+        hi = jnp.where(swap, a, b)
+        cols = jnp.stack([lo, hi], axis=2).reshape(w, length)
+        stride //= 2
+    # sub-lane strides: stay in [w, groups, 128] tiles throughout (flat
+    # [1, length] boolean vectors have no Mosaic lowering)
+    g = cols.reshape(w, length // _LANES, _LANES)
+    lane = lax.broadcasted_iota(jnp.int32, g.shape[1:], 1)  # [groups, 128]
+    while stride >= 1:
+        partner = _xor_partner_grouped(g, stride)
+        low = (lane & stride) == 0
+        xw = [g[i] for i in range(w)]
+        pw = [partner[i] for i in range(w)]
+        p_lt_x = _lex_lt(pw, xw)                 # [groups, 128]
+        x_lt_p = _lex_lt(xw, pw)
+        take = jnp.where(low, p_lt_x, x_lt_p)
+        g = jnp.where(take[None], partner, g)
+        stride //= 2
+    return g.reshape(w, length)
+
+
+def chunk_sort_cols(cols: jax.Array, run: int) -> jax.Array:
+    """Batched full-record sort of contiguous ``run``-sized chunks (XLA)."""
+    w, n = cols.shape
+    m = n // run
+    x = cols.reshape(w, m, run)
+    out = lax.sort(tuple(x[i] for i in range(w)), num_keys=w,
+                   is_stable=False, dimension=1)
+    return jnp.stack(out).reshape(w, n)
+
+
+# ----------------------------------------------------------------------
+# merge-path diagonal search (XLA, vectorized over all tiles of a stage)
+# ----------------------------------------------------------------------
+def _merge_path_offsets(cols: jax.Array, n: int, run: int, tile: int) -> jax.Array:
+    """For each output tile, how many of its pair's A-run elements precede
+    the tile's diagonal — int32[n_tiles].
+
+    Tile ``t`` of pair ``p = t // tpp`` starts at merged rank ``d = (t %
+    tpp) * tile``. The returned ``a`` satisfies: the first ``d`` merged
+    elements are exactly ``A[:a] ∪ B[:d-a]`` under the full-record total
+    order (ties split arbitrarily — harmless, see module docstring).
+    Classic merge-path binary search, vectorized over every tile at once
+    (the gathers are ~n_tiles*W elements — negligible).
+    """
+    w = cols.shape[0]
+    tpp = (2 * run) // tile                   # tiles per pair
+    n_pairs = n // (2 * run)
+    n_tiles = n // tile
+    runs = cols[:, :n].reshape(w, n_pairs, 2 * run)
+
+    pair = jnp.arange(n_tiles, dtype=jnp.int32) // tpp
+    d = (jnp.arange(n_tiles, dtype=jnp.int32) % tpp) * tile
+
+    lo = jnp.maximum(0, d - run)              # a in [lo, hi]
+    hi = jnp.minimum(d, run)
+
+    def gather(words, p, idx):
+        # words: [W, n_pairs, 2R]; p, idx: [n_tiles] -> W x [n_tiles]
+        return [words[i][p, idx] for i in range(w)]
+
+    def body(_, lohi):
+        lo, hi = lohi
+        a = (lo + hi + 1) // 2                # candidate: A contributes a
+        # feasible iff A[a-1] <= B[d-a]  (a > lo guarantees a >= 1 and
+        # d - a < hi' bounds keep indices legal after clamping)
+        ai = jnp.clip(a - 1, 0, run - 1)
+        bi = jnp.clip(d - a, 0, run - 1)
+        a_vals = gather(runs, pair, ai)
+        b_vals = gather(runs, pair, run + bi)
+        # A[a-1] <= B[d-a]  <=>  not (B < A)
+        ok = ~_lex_lt(b_vals, a_vals)
+        # positions where d - a == run would index B out of range; then B
+        # is exhausted below the diagonal and a must be at least d - run
+        # (already enforced by lo); where a - 1 < 0 the predicate is
+        # trivially true (clip handles the index; a == lo skips via mask)
+        ok = ok | (a - 1 < 0)
+        new_lo = jnp.where(ok, a, lo)
+        new_hi = jnp.where(ok, hi, a - 1)
+        return new_lo, new_hi
+
+    # fixed-trip binary search: ceil(log2(run)) + 1 covers the range
+    trips = max(1, int(math.log2(max(2, run))) + 2)
+    lo, hi = lax.fori_loop(0, trips, body, (lo, hi))
+    return lo.astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# the per-stage Pallas kernel
+# ----------------------------------------------------------------------
+def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
+                  *, run, tile, w):
+    """One output tile of one merge stage.
+
+    ``cols_ref``: the full padded array [W, n + tile] in HBM/ANY.
+    ``out_ref``: VMEM block [W, tile] at tile t.
+    ``a_win/b_win``: VMEM scratch [W, tile].
+    """
+    n_tiles = pl.num_programs(0) - 1          # grid has one pad tile
+    t_raw = pl.program_id(0)
+    is_pad = t_raw >= n_tiles
+    # clamp instead of branching: pl.when around the whole body would put
+    # pl.* primitives inside a cond, which the CPU interpreter rejects;
+    # the pad tile computes a harmless real tile and overwrites its
+    # output with padding at the end
+    t = jnp.minimum(t_raw, n_tiles - 1)
+    tpp = (2 * run) // tile
+    p = t // tpp
+    d = (t % tpp) * tile
+    a = aoff_ref[t]
+    b = d - a
+    base = p * (2 * run)
+
+    cp_a = pltpu.make_async_copy(
+        cols_ref.at[:, pl.ds(base + a, tile)], a_win, sem_a)
+    cp_b = pltpu.make_async_copy(
+        cols_ref.at[:, pl.ds(base + run + b, tile)], b_win, sem_b)
+    cp_a.start()
+    cp_b.start()
+    cp_a.wait()
+    cp_b.wait()
+
+    iota = lax.broadcasted_iota(jnp.int32, (1, tile), 1)  # 2D for Mosaic
+    a_valid = iota < (run - a)                           # rest of A-run
+    b_valid = iota < (run - b)                           # rest of B-run
+    ca = jnp.where(a_valid, a_win[...], _FULL)
+    cb = jnp.where(b_valid, b_win[...], _FULL)
+    # ascending ++ descending = bitonic
+    cand = jnp.concatenate([ca, _reverse_cols(cb, tile)],
+                           axis=1)                       # [W, 2*tile]
+    merged = _bitonic_merge_cols(cand, 2 * tile)
+    out_ref[...] = jnp.where(is_pad, _FULL, merged[:, :tile])
+
+
+def _merge_stage(cols_padded: jax.Array, aoff: jax.Array, *, n: int,
+                 run: int, tile: int, interpret: bool) -> jax.Array:
+    """Dispatch one merge stage; returns the new padded array [W, n+tile].
+
+    The trailing ``tile`` columns stay all-ones padding: the extra LAST
+    grid step would have no pair to read, so the grid covers only the
+    real region and the padding block is re-attached by the caller-visible
+    output spec (out block (n + tile)/tile with a guard).
+    """
+    w = cols_padded.shape[0]
+    n_tiles = n // tile
+
+    kernel = functools.partial(_stage_kernel, run=run, tile=tile, w=w)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles + 1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((w, tile), lambda t, aoff: (0, t)),
+        scratch_shapes=[
+            pltpu.VMEM((w, tile), jnp.uint32),
+            pltpu.VMEM((w, tile), jnp.uint32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((w, n + tile), jnp.uint32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(aoff, cols_padded)
+
+
+# ----------------------------------------------------------------------
+# public entry
+# ----------------------------------------------------------------------
+def _pick_tile(w: int) -> int:
+    """Largest power-of-two tile (multiple of 128) whose kernel working
+    set (~2 windows + 2x-candidate merge buffers ~ 8*w*tile*4B) fits
+    comfortably in ~12MB of the ~16MB VMEM."""
+    budget = 12 * 1024 * 1024
+    tile = 1 << 15
+    while 8 * w * tile * 4 > budget and tile > 128:
+        tile //= 2
+    return tile
+
+
+def supports_fast_sort(n: int, run: int = 1 << 15) -> bool:
+    """Fast path needs a power-of-two N with at least two runs."""
+    return n >= 2 * run and (n & (n - 1)) == 0
+
+
+def merge_sort_cols(
+    cols: jax.Array,
+    valid: Optional[jax.Array] = None,
+    run: int = 1 << 15,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Sort columnar records ``uint32[W, N]`` by full-record lexicographic
+    order (ascending). See module docstring for the algorithm and the
+    (non-)stability contract.
+
+    ``valid``: bool[N] — invalid rows sort to the tail and are zeroed.
+    ``run``: initial XLA-sorted run length (power of two).
+    ``tile``: merge kernel tile (default: auto from VMEM budget).
+    ``interpret``: force Pallas interpret mode (defaults to True off-TPU).
+    """
+    w, n = cols.shape
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not supports_fast_sort(n, run):
+        raise ValueError(
+            f"merge_sort_cols needs power-of-two N >= {2*run}, got {n}")
+    if tile is None:
+        tile = min(_pick_tile(w), run)
+    if run % tile:
+        raise ValueError(f"run {run} must be a multiple of tile {tile}")
+
+    if valid is not None:
+        cols = jnp.where(valid[None, :], cols, _FULL)
+
+    cols = chunk_sort_cols(cols, run)
+    # padded work layout [W, N + tile]: B-windows of the last pair may
+    # read up to `tile` past the array; the pad stays all-ones
+    padded = jnp.concatenate(
+        [cols, jnp.full((w, tile), _FULL, jnp.uint32)], axis=1)
+    r = run
+    while r < n:
+        aoff = _merge_path_offsets(padded, n, r, tile)
+        padded = _merge_stage(padded, aoff, n=n, run=r, tile=tile,
+                              interpret=interpret)
+        r *= 2
+    out = padded[:, :n]
+
+    if valid is not None:
+        total = jnp.sum(valid.astype(jnp.int32))
+        keep = lax.iota(jnp.int32, n)[None, :] < total
+        out = jnp.where(keep, out, jnp.uint32(0))
+    return out
+
+
+__all__ = ["merge_sort_cols", "chunk_sort_cols", "supports_fast_sort"]

@@ -87,6 +87,7 @@ class MapOutputStore:
             "num_rounds": plan.num_rounds,
             "out_capacity": plan.out_capacity,
             "capacity": plan.capacity,
+            "split_factor": plan.split_factor,
         }
         (tmp / _META).write_text(json.dumps(meta))
         if d.exists():
@@ -112,6 +113,8 @@ class MapOutputStore:
             num_rounds=int(meta["num_rounds"]),
             out_capacity=int(meta["out_capacity"]),
             capacity=int(meta["capacity"]),
+            # older checkpoints predate skew splitting: default 1
+            split_factor=int(meta.get("split_factor", 1)),
         )
         return records, plan, int(meta["num_parts"])
 

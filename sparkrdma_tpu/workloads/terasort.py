@@ -26,6 +26,7 @@ import time
 from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
@@ -102,8 +103,13 @@ def device_verify_sort(
     O(n log n) host-side permutation check (bench scale: the host check
     would dwarf the measured exchange):
 
-    - conservation: record count and per-word uint32 checksums of the
-      output's valid prefix match the input's;
+    - conservation: record count, per-word uint32 sums, AND a summed
+      per-record multiplicative hash of the output's valid prefix match
+      the input's. Plain per-word sums are blind to multi-record
+      cancellations (e.g. dup {2,2} replacing {1,3} in one word); the
+      mixed hash makes such dup/drop pairs collide only if the full
+      word-mixing hash sums collide mod 2^32 — no longer constructible
+      by linear arithmetic on single words;
     - intra-device order: every device's valid prefix is lexicographically
       non-decreasing on the key words;
     - inter-device order: device boundaries ascend (first/last keys).
@@ -121,8 +127,19 @@ def device_verify_sort(
     ax = rt.axis_name
     w = records.shape[0]
 
+    def rec_hash(cols):
+        """Per-record word-mixing hash (murmur-style, order-invariant
+        only across records, not across words within a record)."""
+        h = jnp.full(cols.shape[1], 0x9E3779B9, jnp.uint32)
+        for i in range(w):
+            h = h ^ (cols[i] * jnp.uint32(0x85EBCA6B))
+            h = (h << 13) | (h >> 19)
+            h = h * jnp.uint32(0xC2B2AE35)
+        return h
+
     def in_sums(cols):
-        s = jnp.stack([jnp.sum(cols[i], dtype=jnp.uint32) for i in range(w)])
+        s = jnp.stack([jnp.sum(cols[i], dtype=jnp.uint32) for i in range(w)]
+                      + [jnp.sum(rec_hash(cols), dtype=jnp.uint32)])
         n = jnp.full((1,), cols.shape[1], jnp.int32)
         return s[None], n
 
@@ -130,7 +147,8 @@ def device_verify_sort(
         valid = jnp.arange(out_capacity) < total[0]
         vu = valid.astype(jnp.uint32)
         s = jnp.stack([jnp.sum(cols[i] * vu, dtype=jnp.uint32)
-                       for i in range(w)])
+                       for i in range(w)]
+                      + [jnp.sum(rec_hash(cols) * vu, dtype=jnp.uint32)])
         count = total[0]
         # lexicographic non-decreasing over key words on the valid prefix
         gt = jnp.zeros((out_capacity - 1,), bool)   # prev > next so far
@@ -196,7 +214,11 @@ def run_terasort(
     and ``sort_exchange_s`` is the per-iteration mean — amortizing
     per-dispatch latency exactly as line-rate NIC numbers do.
     ``device_verify`` adds the cheap on-device invariant check
-    (:func:`device_verify_sort`), usable at bench scale."""
+    (:func:`device_verify_sort`), usable at bench scale.
+
+    The returned ``sorted_records`` is detached from the shuffle's pooled
+    buffer (copied before ``unregister_shuffle`` releases that buffer to
+    the pool), so callers may hold it across later exchanges safely."""
     rt = manager.runtime
     mesh = rt.num_partitions
     kw = manager.conf.key_words
@@ -256,6 +278,11 @@ def run_terasort(
             sort_exchange_s=sort_exchange_s,
             verified=verified,
         )
+        # detach from the pool-recycled exchange buffer: the finally
+        # block's unregister releases that buffer for reuse, and a later
+        # same-shape exchange would donate (delete) it out from under the
+        # caller (round-2 advisor finding)
+        out = jnp.array(out)
         return res, out, totals
     finally:
         manager.unregister_shuffle(shuffle_id)

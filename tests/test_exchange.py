@@ -129,15 +129,46 @@ def test_empty_partitions_ok(exchange, rng):
     run_and_check(exchange, xg, x, modulo_partitioner(8), 8, rng)
 
 
-def test_plan_rejects_excessive_skew(exchange, rng):
+def test_plan_splits_excessive_skew(exchange, rng):
+    """One hot partition needing 32 rounds with max_rounds=4: the plan
+    must split it into same-device sub-partitions and succeed (SURVEY.md
+    §7 hard-part 2), with every record still delivered to the owner
+    device of the ORIGINAL partition."""
     ex, rt = exchange
     conf = ShuffleConf(slot_records=2, max_rounds=4)
     ex2 = ShuffleExchange(rt.mesh, rt.axis_name, conf)
     x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
-    x[:, 0] = 0
+    x[:, 0] = 0                       # every record -> partition 0
     xg = rt.shard_records(x)
-    with pytest.raises(ValueError, match="skew"):
-        ex2.plan(xg, modulo_partitioner(8))
+    plan = ex2.plan(xg, modulo_partitioner(8))
+    assert plan.split_factor > 1
+    assert plan.num_rounds <= conf.max_rounds
+    out, totals, _ = ex2.exchange(xg, modulo_partitioner(8), plan)
+    tot = np.asarray(totals)
+    # partition 0 is owned by device 0; splitting must not move it
+    assert tot[0] == x.shape[0] and tot[1:].sum() == 0
+    dev0 = np.asarray(out)[:, :int(tot[0])].T
+    canon = lambda a: a[np.lexsort(tuple(a[:, c]
+                                         for c in range(a.shape[1])))]
+    np.testing.assert_array_equal(canon(dev0), canon(x))
+
+
+def test_split_plan_rejects_partition_range_reads(rng):
+    from sparkrdma_tpu import MeshRuntime
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    conf = ShuffleConf(slot_records=2, max_rounds=4)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        part = modulo_partitioner(8)
+        x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
+        x[:, 0] = 0
+        h = m.register_shuffle(60, 8, part)
+        m.get_writer(h).write(m.runtime.shard_records(x)).stop(True)
+        out, totals = m.get_reader(h).read()   # full range is fine
+        assert int(np.asarray(totals).sum()) == x.shape[0]
+        with pytest.raises(ValueError, match="skew-split"):
+            m.get_reader(h, 0, 1).read()
+        m.unregister_shuffle(60)
 
 
 def test_repartition_256_geometry(exchange, rng):
@@ -232,3 +263,22 @@ class TestPallasRingTransport:
         _, rt = ring_exchange
         xg, xn = make_global_records(rng, rt, 24)
         run_and_check(ring_exchange, xg, xn, modulo_partitioner(8), 8, rng)
+
+
+def test_plan_split_extreme_odd_factor(exchange, rng):
+    """33-round skew against max_rounds=4 forces a non-power-of-two
+    split factor; the plan must still land within the round budget and
+    deliver every record (position splitting is uniform by construction,
+    so the post-split give-up raise is defensive-only)."""
+    ex, rt = exchange
+    conf = ShuffleConf(slot_records=2, max_rounds=4)
+    ex2 = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+    x = rng.integers(1, 2**32, size=(8 * 65, 4), dtype=np.uint32)
+    x[:, 0] = 3                          # all -> partition 3
+    xg = rt.shard_records(x)
+    plan = ex2.plan(xg, modulo_partitioner(8), capacity=2)
+    assert plan.num_rounds <= 4
+    assert plan.split_factor >= 9        # ceil(ceil(65/2)/4) = 9
+    out, totals, _ = ex2.exchange(xg, modulo_partitioner(8), plan)
+    tot = np.asarray(totals)
+    assert tot[3] == x.shape[0] and tot.sum() == x.shape[0]

@@ -113,3 +113,104 @@ def test_unregister_deletes_checkpoint(tmp_path, rng):
         assert m.store.contains(6)
         m.unregister_shuffle(6)
         assert not m.store.contains(6)
+
+
+class TestBackendFailureMapping:
+    """The error-CQE analogue: a REAL backend error (jax.errors.
+    JaxRuntimeError) escaping the compiled exchange must map to
+    FetchFailedError and ride the same stage-retry loop as injected
+    faults (reference: error completions -> RdmaCompletionListener
+    .onFailure -> FetchFailedException)."""
+
+    @staticmethod
+    def _failing_exchange(m, n_failures):
+        """Wrap the live exchange: raise JaxRuntimeError n times, then
+        delegate to the real compiled path."""
+        import jax
+
+        real = m._exchange.exchange
+        state = {"left": n_failures, "calls": 0}
+
+        def wrapped(*a, **kw):
+            state["calls"] += 1
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise jax.errors.JaxRuntimeError(
+                    "DATA_LOSS: simulated device read failure")
+            return real(*a, **kw)
+
+        m._exchange.exchange = wrapped
+        return state
+
+    def test_transient_backend_error_retried(self, rng):
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=5)
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(7, 8, modulo_partitioner(8,
+                                                                 key_word=1))
+            x = _write(m, handle, rng)
+            state = self._failing_exchange(m, 2)
+            out, totals = m.get_reader(handle).read()
+            assert state["calls"] == 3  # two failures + one success
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+
+    def test_persistent_backend_error_gives_up(self, rng):
+        import jax
+
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=3)
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(8, 8, modulo_partitioner(8,
+                                                                 key_word=1))
+            _write(m, handle, rng)
+            self._failing_exchange(m, 99)
+            with pytest.raises(FetchFailedError) as ei:
+                m.get_reader(handle).read()
+            assert ei.value.attempt == 3
+            # the cause chain preserves the backend error for debugging
+            cause = ei.value.__cause__
+            while cause is not None:
+                if isinstance(cause, jax.errors.JaxRuntimeError):
+                    break
+                cause = cause.__cause__
+            assert cause is not None, "JaxRuntimeError lost from chain"
+
+    def test_backend_error_recovers_via_checkpoint(self, tmp_path, rng):
+        """Backend failure + lost HBM map output in one blow: the retry
+        loop must restore the writer from the host checkpoint and then
+        succeed — the full 'executor died, shuffle files survive' story."""
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=3,
+                           spill_to_host=True,
+                           spill_dir=str(tmp_path / "ckpt_be"))
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(9, 8, modulo_partitioner(8,
+                                                                 key_word=1))
+            x = _write(m, handle, rng)
+            ref_out, ref_tot = map(np.asarray, m.get_reader(handle).read())
+            state = self._failing_exchange(m, 1)
+            m._writers.clear()   # device-resident map output gone too
+            out, totals = m.get_reader(handle).read()
+            assert state["calls"] == 2
+            assert np.array_equal(np.asarray(totals), ref_tot)
+            assert np.array_equal(np.asarray(out), ref_out)
+
+
+def test_skew_split_shuffle_resumes_from_checkpoint(tmp_path, rng):
+    """split_factor must round-trip through the checkpoint: a resumed
+    skew-split shuffle read must re-wrap the partitioner, not fail the
+    num_parts check or silently drop the hot partition's overflow."""
+    conf = ShuffleConf(slot_records=2, max_rounds=4, spill_to_host=True,
+                       spill_dir=str(tmp_path / "ckpt_split"))
+    part = modulo_partitioner(8)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(20, 8, part)
+        x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
+        x[:, 0] = 0                      # everything to partition 0
+        plan = m.get_writer(handle).write(
+            m.runtime.shard_records(x)).stop(True)
+        assert plan.split_factor > 1
+        ref_out, ref_tot = map(np.asarray, m.get_reader(handle).read())
+        m._writers.clear()               # device map output lost
+        out, totals = m.get_reader(handle).read()   # resume path
+        resumed = m._writers[20].plan
+        assert resumed.split_factor == plan.split_factor
+        assert np.array_equal(np.asarray(totals), ref_tot)
+        assert np.array_equal(np.asarray(out), ref_out)

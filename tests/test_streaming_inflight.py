@@ -97,3 +97,58 @@ def test_pool_serves_fused_output_ping_pong(rng):
         assert stats["hits"] >= 1, stats
     finally:
         m.stop()
+
+
+def test_donation_aliasing_stress(rng):
+    """Stress the put_shaped-while-enqueued contract (protocol.py
+    _exchange_streaming): recv buffers are returned to the pool
+    immediately after the fold that reads them is ENQUEUED, trusting the
+    runtime to sequence the next donation after the enqueued read. Deep
+    queue_depth keeps many chunks in flight; queue_depth=1 forces
+    blocking reuse; two interleaved same-geometry shuffles maximize
+    same-shape buffer churn. A use-after-donate here would be silent
+    corruption, so outputs are checked bit-identical across depths and
+    interleavings (round-2 verdict weak #6)."""
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    n_per_dev = 128
+    xa = rng.integers(1, 2**32, size=(8 * n_per_dev, 4), dtype=np.uint32)
+    xb = rng.integers(1, 2**32, size=(8 * n_per_dev, 4), dtype=np.uint32)
+    # skew every record of both shuffles into partition 0 via word 0 so
+    # the (src->part0) pair needs n_per_dev/capacity = 16 rounds
+    xa[:, 0] = 0
+    xb[:, 0] = 0
+    part = modulo_partitioner(8)
+
+    def run(queue_depth, reads):
+        conf = ShuffleConf(slot_records=8, max_rounds=32,
+                           max_rounds_in_flight=2,
+                           queue_depth=queue_depth)
+        outs = []
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            ha = m.register_shuffle(100, 8, part)
+            hb = m.register_shuffle(101, 8, part)
+            m.get_writer(ha).write(m.runtime.shard_records(xa)).stop(True)
+            m.get_writer(hb).write(m.runtime.shard_records(xb)).stop(True)
+            pa = m._writers[100].plan
+            assert pa.num_rounds >= 8, pa.num_rounds
+            assert m._exchange.conf.max_rounds_in_flight < pa.num_rounds
+            for _ in range(reads):
+                oa, ta = m.get_reader(ha).read()
+                ob, tb = m.get_reader(hb).read()
+                # consume immediately (pooled buffers are recycled by the
+                # next same-geometry exchange)
+                outs.append((np.asarray(oa), np.asarray(ta),
+                             np.asarray(ob), np.asarray(tb)))
+            stats = m.runtime.pool.stats()
+        return outs, stats
+
+    deep, deep_stats = run(queue_depth=8, reads=3)
+    shallow, _ = run(queue_depth=1, reads=3)
+    # every repetition and both depths must agree bit-for-bit
+    ref = deep[0]
+    for got in deep[1:] + shallow:
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r, g)
+    # the pool genuinely served the streaming path (recv chunks recycled)
+    assert deep_stats["hits"] > 0, deep_stats
