@@ -377,10 +377,10 @@ class ShuffleManager:
         ``writer`` lets a caller checkpoint its own state directly (the
         stop() path uses this) so a writer displaced from the manager's
         table by a later ``get_writer`` still checkpoints what it
-        published. Multi-host limitation: if the records span devices
-        this process cannot address, the checkpoint is skipped with a
-        warning (per-process sharded spill is future work), never a
-        mid-stop crash.
+        published. Multi-host: when the records span devices this
+        process cannot address, each process spills only its OWN shards
+        (``MapOutputStore.save_shards``) — the reference's per-executor
+        shuffle files, where no executor writes another's map output.
         """
         if self.store is None:
             raise RuntimeError("no MapOutputStore configured "
@@ -392,10 +392,16 @@ class ShuffleManager:
                 f"shuffle {handle.shuffle_id}: nothing published to "
                 "checkpoint")
         if not writer.records.is_fully_addressable:
-            log.warning(
-                "shuffle %d: records span non-addressable devices; "
-                "skipping host checkpoint (multi-host spill unsupported)",
-                handle.shuffle_id)
+            records = writer.records
+            n = records.shape[1]
+            shard_len = n // self.runtime.num_partitions
+            shards = []
+            for sh in records.addressable_shards:
+                coord = int(sh.index[1].start) // shard_len
+                shards.append((coord, np.asarray(sh.data)))
+            self.store.save_shards(
+                handle.shuffle_id, shards, writer.plan, handle.num_parts,
+                records.shape, jax.process_index(), jax.process_count())
             return
         self.store.save(handle.shuffle_id, np.asarray(writer.records),
                         writer.plan, handle.num_parts)
@@ -411,7 +417,9 @@ class ShuffleManager:
         if self.store is None:
             raise RuntimeError("no MapOutputStore configured "
                                "(set conf.spill_dir or pass store=)")
-        records_np, plan, num_parts = self.store.load(handle.shuffle_id)
+        meta = self.store.load_meta(handle.shuffle_id)
+        plan = self.store.plan_from_meta(meta)
+        num_parts = int(meta["num_parts"])
         if num_parts != handle.num_parts:
             raise ValueError(
                 f"checkpoint has num_parts={num_parts}, handle says "
@@ -424,14 +432,33 @@ class ShuffleManager:
                 f"checkpoint was taken on a {plan.counts.shape[0]}-device "
                 f"mesh; current mesh has {mesh_now} devices — re-run the "
                 "map stage instead of resuming")
+        shape = tuple(meta["shape"])
+        shard_len = shape[1] // mesh_now
+        if meta.get("sharded"):
+            # per-process reload: the callback is only ever invoked for
+            # this process's addressable shards, so each process touches
+            # only its own files (its executor-local shuffle files)
+            store, sid = self.store, handle.shuffle_id
+
+            def read(idx):
+                coord = int(idx[1].start or 0) // shard_len
+                return store.read_shard(sid, coord,
+                                        (shape[0], shard_len))[idx[0], :]
+
+            records = jax.make_array_from_callback(
+                shape, self.runtime.sharding(None, self.runtime.axis_name),
+                read)
+        else:
+            records_np = self.store.read_records(handle.shuffle_id, meta)
+            records = jax.make_array_from_callback(
+                records_np.shape,
+                self.runtime.sharding(None, self.runtime.axis_name),
+                lambda idx: records_np[idx])
         w = ShuffleWriter(self, handle)
         # checkpoints store the columnar [W, N] batch; reshard over N
         # (make_array_from_callback: works when some devices are
         # non-addressable, unlike a global device_put)
-        w._records = jax.make_array_from_callback(
-            records_np.shape,
-            self.runtime.sharding(None, self.runtime.axis_name),
-            lambda idx: records_np[idx])
+        w._records = records
         w._plan = plan
         self._writers[handle.shuffle_id] = w
         self._plan_seconds[handle.shuffle_id] = 0.0

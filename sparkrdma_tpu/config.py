@@ -86,8 +86,13 @@ class ShuffleConf:
     # --- transport backend ---
     #: "xla" = lax.all_to_all (compiler-scheduled, default);
     #: "pallas_ring" = explicit one-sided remote-DMA kernel
-    #: (exchange/ring.py, the RdmaChannel analogue)
+    #: (exchange/ring.py, the RdmaChannel analogue);
+    #: "hierarchical" = two-stage intra-host (ICI) + inter-host (DCN)
+    #: all_to_all (exchange/hierarchical.py, the multi-slice transport)
     transport: str = "xla"
+    #: host-group count for the hierarchical transport; 0 = auto from the
+    #: mesh's process set (devices per host = mesh size / processes)
+    hierarchy_hosts: int = 0
 
     # --- observability ---
     collect_shuffle_read_stats: bool = False
@@ -108,8 +113,10 @@ class ShuffleConf:
             raise ValueError("key_words must be >=1, val_words >=0")
         if self.max_rounds <= 0 or self.max_rounds_in_flight <= 0:
             raise ValueError("round counts must be positive")
-        if self.transport not in ("xla", "pallas_ring"):
+        if self.transport not in ("xla", "pallas_ring", "hierarchical"):
             raise ValueError(f"unknown transport {self.transport!r}")
+        if self.hierarchy_hosts < 0:
+            raise ValueError("hierarchy_hosts must be >= 0")
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property

@@ -1,0 +1,89 @@
+"""Hierarchical (two-stage) all-to-all — the multi-slice / DCN transport.
+
+SparkRDMA treats every peer uniformly: each reducer opens one RC channel
+per remote executor and READs over whatever fabric connects them (§2.5 —
+the NIC/switch hides topology). A TPU pod is not uniform: chips within a
+slice talk over ICI (~Tb/s), slices talk over DCN (~10s of Gb/s), so a
+flat ``all_to_all`` over a multi-slice mesh sends L x L small messages
+between every pair of hosts. The classical fix (NCCL/MPI hierarchical
+alltoall) is two staged exchanges:
+
+1. **Intra-host** (ICI): devices within a host exchange so that local
+   device ``l`` consolidates every chunk its host holds that is bound
+   for remote-local-rank ``l``;
+2. **Inter-host** (DCN): same-rank devices across hosts exchange the
+   consolidated bundles — each host pair moves ``L`` large messages
+   instead of ``L^2`` small ones, and the DCN hop count per byte is 1.
+
+Derivation (device ``(h, l)``, hosts ``H`` x locals ``L``, dest-major
+slot tensor ``X[d']`` with ``d' = h' * L + l'``):
+
+- stage 1 over intra-host groups, splitting the ``l'`` axis:
+  device ``(h, l')`` ends with ``Y[h', src_l] = X@(h, src_l)[h'L + l']``;
+- stage 2 over same-``l`` groups, splitting the ``h'`` axis:
+  device ``(h', l')`` ends with ``Z[src_h, src_l] =
+  X@(src_h, src_l)[h'L + l']`` — exactly the flat all_to_all's
+  source-major result, reshaped.
+
+Both stages are ``lax.all_to_all`` with ``axis_index_groups`` over the
+SAME flat mesh axis, so this composes with the existing shard_map
+programs: select it with ``ShuffleConf(transport="hierarchical",
+hierarchy_hosts=H)``. With ``hierarchy_hosts`` unset the process count
+is used (devices per host = devices / processes), matching the physical
+ICI/DCN boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def hierarchy_for(mesh, axis_name: str, hosts: int = 0) -> int:
+    """Resolve the host-group count for a mesh (0 = auto from processes)."""
+    size = int(mesh.shape[axis_name])
+    if hosts == 0:
+        procs = {d.process_index for d in mesh.devices.flat}
+        hosts = len(procs)
+    if hosts <= 0 or size % hosts:
+        raise ValueError(
+            f"hierarchy hosts {hosts} must divide mesh size {size}")
+    return hosts
+
+
+def make_hierarchical_all_to_all(mesh, axis_name: str,
+                                 hosts: int = 0) -> Callable:
+    """Build the two-stage a2a with the flat transport's contract:
+    dest-major ``[mesh, ...]`` in, source-major ``[mesh, ...]`` out."""
+    size = int(mesh.shape[axis_name])
+    h = hierarchy_for(mesh, axis_name, hosts)
+    local = size // h
+    if h == 1 or local == 1:
+        # degenerate hierarchy: one host or one device per host — the
+        # flat exchange IS the correct algorithm
+        def flat(slots):
+            return lax.all_to_all(slots, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        return flat
+
+    intra = [[hh * local + ll for ll in range(local)] for hh in range(h)]
+    inter = [[hh * local + ll for hh in range(h)] for ll in range(local)]
+
+    def a2a(slots: jax.Array) -> jax.Array:
+        # slots: [size, ...] dest-major (entry d' bound for device d')
+        rest = slots.shape[1:]
+        x = slots.reshape((h, local) + rest)       # [h', l', ...]
+        # stage 1 (ICI): split l', concat src_l -> [h', src_l, ...]
+        y = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=1,
+                           tiled=True, axis_index_groups=intra)
+        # stage 2 (DCN): split h', concat src_h -> [src_h, src_l, ...]
+        z = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True, axis_index_groups=inter)
+        return z.reshape((size,) + rest)           # source-major
+
+    return a2a
+
+
+__all__ = ["make_hierarchical_all_to_all", "hierarchy_for"]

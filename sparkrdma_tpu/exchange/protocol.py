@@ -308,6 +308,12 @@ class ShuffleExchange:
             from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
 
             return make_ring_all_to_all(self.mesh, ax)
+        if self.conf.transport == "hierarchical":
+            from sparkrdma_tpu.exchange.hierarchical import (
+                make_hierarchical_all_to_all)
+
+            return make_hierarchical_all_to_all(
+                self.mesh, ax, self.conf.hierarchy_hosts)
 
         def a2a(slots):
             return lax.all_to_all(slots, ax, split_axis=0,

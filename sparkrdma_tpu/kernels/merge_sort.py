@@ -50,10 +50,13 @@ _FULL = np.uint32(0xFFFFFFFF)   # numpy scalar: kernels may close over it
 
 
 def _lex_lt(a_words, b_words):
-    """Lexicographic a < b over aligned word lists (uint32)."""
-    lt = jnp.zeros(a_words[0].shape, bool)
-    eq = jnp.ones(a_words[0].shape, bool)
-    for a, b in zip(a_words, b_words):
+    """Lexicographic a < b over aligned word lists (uint32).
+
+    Seeded from the first word (no boolean constants: Mosaic lacks an
+    i8->i1 truncation for materialized bool tensors)."""
+    lt = a_words[0] < b_words[0]
+    eq = a_words[0] == b_words[0]
+    for a, b in zip(a_words[1:], b_words[1:]):
         lt = lt | (eq & (a < b))
         eq = eq & (a == b)
     return lt
@@ -130,7 +133,10 @@ def _bitonic_merge_cols(cols, length):
         pw = [partner[i] for i in range(w)]
         p_lt_x = _lex_lt(pw, xw)                 # [groups, 128]
         x_lt_p = _lex_lt(xw, pw)
-        take = jnp.where(low, p_lt_x, x_lt_p)
+        # logical blend, not where-on-bools: a select with boolean
+        # BRANCH values round-trips through i8 and Mosaic cannot
+        # truncate i8 vectors back to i1
+        take = (low & p_lt_x) | (~low & x_lt_p)
         g = jnp.where(take[None], partner, g)
         stride //= 2
     return g.reshape(w, length)
@@ -209,11 +215,17 @@ def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
                   *, run, tile, w):
     """One output tile of one merge stage.
 
-    ``cols_ref``: the full padded array [W, n + tile] in HBM/ANY.
+    ``cols_ref``: the full padded array [W, n + 2*tile] in HBM/ANY.
     ``out_ref``: VMEM block [W, tile] at tile t.
-    ``a_win/b_win``: VMEM scratch [W, tile].
+    ``a_win/b_win``: VMEM scratch [W, tile + 128].
+
+    HBM DMA offsets must be 128-lane aligned (Mosaic tiling), but the
+    merge-path offsets ``a``/``b`` are arbitrary — so each window loads
+    ``tile + 128`` from the aligned floor, a dynamic lane-roll shifts
+    the misalignment out, and a static slice keeps the first ``tile``
+    genuine elements.
     """
-    n_tiles = pl.num_programs(0) - 1          # grid has one pad tile
+    n_tiles = pl.num_programs(0) - 2          # grid has two pad tiles
     t_raw = pl.program_id(0)
     is_pad = t_raw >= n_tiles
     # clamp instead of branching: pl.when around the whole body would put
@@ -227,21 +239,27 @@ def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
     a = aoff_ref[t]
     b = d - a
     base = p * (2 * run)
+    sa = a & 127
+    sb = b & 127
 
     cp_a = pltpu.make_async_copy(
-        cols_ref.at[:, pl.ds(base + a, tile)], a_win, sem_a)
+        cols_ref.at[:, pl.ds(base + (a - sa), tile + 128)], a_win, sem_a)
     cp_b = pltpu.make_async_copy(
-        cols_ref.at[:, pl.ds(base + run + b, tile)], b_win, sem_b)
+        cols_ref.at[:, pl.ds(base + run + (b - sb), tile + 128)],
+        b_win, sem_b)
     cp_a.start()
     cp_b.start()
     cp_a.wait()
     cp_b.wait()
 
+    wa = pltpu.roll(a_win[...], shift=-sa, axis=1)[:, :tile]
+    wb = pltpu.roll(b_win[...], shift=-sb, axis=1)[:, :tile]
+
     iota = lax.broadcasted_iota(jnp.int32, (1, tile), 1)  # 2D for Mosaic
     a_valid = iota < (run - a)                           # rest of A-run
     b_valid = iota < (run - b)                           # rest of B-run
-    ca = jnp.where(a_valid, a_win[...], _FULL)
-    cb = jnp.where(b_valid, b_win[...], _FULL)
+    ca = jnp.where(a_valid, wa, _FULL)
+    cb = jnp.where(b_valid, wb, _FULL)
     # ascending ++ descending = bitonic
     cand = jnp.concatenate([ca, _reverse_cols(cb, tile)],
                            axis=1)                       # [W, 2*tile]
@@ -251,12 +269,12 @@ def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
 
 def _merge_stage(cols_padded: jax.Array, aoff: jax.Array, *, n: int,
                  run: int, tile: int, interpret: bool) -> jax.Array:
-    """Dispatch one merge stage; returns the new padded array [W, n+tile].
+    """Dispatch one merge stage; returns the new padded array
+    [W, n + 2*tile].
 
-    The trailing ``tile`` columns stay all-ones padding: the extra LAST
-    grid step would have no pair to read, so the grid covers only the
-    real region and the padding block is re-attached by the caller-visible
-    output spec (out block (n + tile)/tile with a guard).
+    The trailing ``2*tile`` columns stay all-ones padding (aligned
+    B-windows of the last pair may read up to ``tile + 128`` past the
+    real region); the two extra grid steps re-emit padding blocks.
     """
     w = cols_padded.shape[0]
     n_tiles = n // tile
@@ -264,12 +282,12 @@ def _merge_stage(cols_padded: jax.Array, aoff: jax.Array, *, n: int,
     kernel = functools.partial(_stage_kernel, run=run, tile=tile, w=w)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n_tiles + 1,),
+        grid=(n_tiles + 2,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((w, tile), lambda t, aoff: (0, t)),
         scratch_shapes=[
-            pltpu.VMEM((w, tile), jnp.uint32),
-            pltpu.VMEM((w, tile), jnp.uint32),
+            pltpu.VMEM((w, tile + 128), jnp.uint32),
+            pltpu.VMEM((w, tile + 128), jnp.uint32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
@@ -277,7 +295,7 @@ def _merge_stage(cols_padded: jax.Array, aoff: jax.Array, *, n: int,
 
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((w, n + tile), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((w, n + 2 * tile), jnp.uint32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(aoff, cols_padded)
@@ -333,10 +351,11 @@ def merge_sort_cols(
         cols = jnp.where(valid[None, :], cols, _FULL)
 
     cols = chunk_sort_cols(cols, run)
-    # padded work layout [W, N + tile]: B-windows of the last pair may
-    # read up to `tile` past the array; the pad stays all-ones
+    # padded work layout [W, N + 2*tile]: aligned B-windows of the last
+    # pair may read up to tile + 128 past the array; the pad stays
+    # all-ones across stages
     padded = jnp.concatenate(
-        [cols, jnp.full((w, tile), _FULL, jnp.uint32)], axis=1)
+        [cols, jnp.full((w, 2 * tile), _FULL, jnp.uint32)], axis=1)
     r = run
     while r < n:
         aoff = _merge_path_offsets(padded, n, r, tile)

@@ -97,14 +97,154 @@ class MapOutputStore:
                  shuffle_id, records.shape, d)
         return d
 
-    def load(self, shuffle_id: int) -> Tuple[np.ndarray, ShufflePlan, int]:
-        """Returns ``(records, plan, num_parts)``; KeyError if absent."""
+    # ------------------------------------------------------------------
+    # multi-host sharded checkpoints: each process persists only the
+    # shards it can address (the reference's per-executor shuffle files —
+    # no executor ever writes another executor's map output), and a
+    # resuming process reads only its own shards back.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _save_id(plan: ShufflePlan, global_shape) -> str:
+        """Content fingerprint shared by every process WITHOUT
+        communication: all processes hold the identical plan. A re-save
+        after re-running the map produces (in practice) different counts
+        -> different id -> stale markers read as incomplete."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(plan.counts).tobytes())
+        h.update(repr((plan.num_rounds, plan.out_capacity, plan.capacity,
+                       plan.split_factor, tuple(global_shape))).encode())
+        return h.hexdigest()[:16]
+
+    def save_shards(self, shuffle_id: int,
+                    shards: List[Tuple[int, np.ndarray]],
+                    plan: ShufflePlan, num_parts: int, global_shape,
+                    process_index: int, num_processes: int) -> Path:
+        """Persist this process's shards (``[(mesh_coord, data), ...]``).
+
+        Layout: ``shuffle_N/shard_{coord}.u32`` + per-process marker
+        ``proc{p}.json``; process 0 additionally writes the global
+        ``meta.json`` (with ``sharded: true``). Completeness gate: the
+        meta AND every process marker must exist AND carry the same
+        ``save_id`` (a plan fingerprint — no cross-process coordination
+        needed). Every file lands via tmp + atomic rename, markers/meta
+        last, so a crash mid-save (or mid-RE-save with a changed plan)
+        reads as incomplete/absent rather than as mixed data. Limitation
+        (documented, not detectable without coordination): re-saving
+        different records under a byte-identical plan can tear.
+        """
+        d = self._dir(shuffle_id)
+        d.mkdir(parents=True, exist_ok=True)
+        save_id = self._save_id(plan, global_shape)
+        spool = SpillWriter(depth=self.spool_depth,
+                            use_native=self.use_native)
+        tmp_paths = []
+        try:
+            for coord, data in shards:
+                data = np.ascontiguousarray(data, dtype=np.uint32)
+                tmp = d / f"shard_{coord}.u32.tmp"
+                spool.submit(str(tmp), data)
+                tmp_paths.append((tmp, d / f"shard_{coord}.u32"))
+            errors = spool.drain()
+        finally:
+            spool.close()
+        if errors:
+            for tmp, _ in tmp_paths:
+                tmp.unlink(missing_ok=True)
+            raise OSError(f"sharded spill of shuffle {shuffle_id} failed "
+                          f"({errors} errors)")
+        for tmp, final in tmp_paths:
+            tmp.replace(final)
+        marker = {"process_index": process_index,
+                  "save_id": save_id,
+                  "shards": sorted(c for c, _ in shards),
+                  "shard_shapes": {str(c): list(a.shape)
+                                   for c, a in shards}}
+        mtmp = d / f"proc{process_index}.json.tmp"
+        mtmp.write_text(json.dumps(marker))
+        mtmp.replace(d / f"proc{process_index}.json")
+        if process_index == 0:
+            meta = {
+                "shuffle_id": shuffle_id,
+                "num_parts": num_parts,
+                "shape": list(global_shape),
+                "counts": plan.counts.tolist(),
+                "num_rounds": plan.num_rounds,
+                "out_capacity": plan.out_capacity,
+                "capacity": plan.capacity,
+                "split_factor": plan.split_factor,
+                "sharded": True,
+                "save_id": save_id,
+                "num_processes": num_processes,
+            }
+            gtmp = d / (_META + ".tmp")
+            gtmp.write_text(json.dumps(meta))
+            gtmp.replace(d / _META)
+        log.info("checkpointed shuffle %d shards %s (proc %d) -> %s",
+                 shuffle_id, [c for c, _ in shards], process_index, d)
+        return d
+
+    def load_meta(self, shuffle_id: int) -> dict:
+        """Global checkpoint metadata (raises KeyError if absent or, for
+        sharded checkpoints, incomplete)."""
         d = self._dir(shuffle_id)
         meta_path = d / _META
         if not meta_path.exists():
             raise KeyError(f"no checkpoint for shuffle {shuffle_id} "
                            f"under {self.root}")
         meta = json.loads(meta_path.read_text())
+        if meta.get("sharded"):
+            want = meta.get("save_id")
+            for p in range(int(meta["num_processes"])):
+                mp = d / f"proc{p}.json"
+                if not mp.exists():
+                    raise KeyError(
+                        f"sharded checkpoint for shuffle {shuffle_id} is "
+                        f"incomplete: missing proc{p}.json")
+                marker = json.loads(mp.read_text())
+                if marker.get("save_id") != want:
+                    raise KeyError(
+                        f"sharded checkpoint for shuffle {shuffle_id} is "
+                        f"torn: proc{p} save_id mismatch")
+        return meta
+
+    def plan_from_meta(self, meta: dict) -> ShufflePlan:
+        return ShufflePlan(
+            counts=np.asarray(meta["counts"], dtype=np.int64),
+            num_rounds=int(meta["num_rounds"]),
+            out_capacity=int(meta["out_capacity"]),
+            capacity=int(meta["capacity"]),
+            split_factor=int(meta.get("split_factor", 1)),
+        )
+
+    def read_shard(self, shuffle_id: int, coord: int,
+                   shape) -> np.ndarray:
+        return read_array(str(self._dir(shuffle_id) / f"shard_{coord}.u32"),
+                          np.uint32, tuple(shape),
+                          use_native=self.use_native)
+
+    def read_records(self, shuffle_id: int, meta: dict) -> np.ndarray:
+        """Records of a NON-sharded checkpoint, given already-loaded
+        metadata (avoids re-parsing meta on the resume path)."""
+        return read_array(str(self._dir(shuffle_id) / _RECORDS), np.uint32,
+                          tuple(meta["shape"]),
+                          use_native=self.use_native)
+
+    def load(self, shuffle_id: int) -> Tuple[np.ndarray, ShufflePlan, int]:
+        """Returns ``(records, plan, num_parts)``; KeyError if absent.
+
+        Single-file checkpoints only — sharded checkpoints are resumed
+        shard-by-shard via :meth:`load_meta` / :meth:`read_shard`
+        (``ShuffleManager.resume_shuffle`` does this), since no single
+        process can materialize the global array.
+        """
+        d = self._dir(shuffle_id)
+        meta = self.load_meta(shuffle_id)
+        if meta.get("sharded"):
+            raise ValueError(
+                f"shuffle {shuffle_id} is a sharded (multi-host) "
+                "checkpoint; resume via ShuffleManager.resume_shuffle")
         records = read_array(str(d / _RECORDS), np.uint32,
                              tuple(meta["shape"]),
                              use_native=self.use_native)
@@ -119,7 +259,16 @@ class MapOutputStore:
         return records, plan, int(meta["num_parts"])
 
     def contains(self, shuffle_id: int) -> bool:
-        return (self._dir(shuffle_id) / _META).exists()
+        """True only for COMPLETE checkpoints (sharded: every process
+        marker present with a matching save_id), so auto-recovery never
+        resumes a torn save. A truncated meta.json (crash mid-write of a
+        pre-atomic-rename layout) reads as absent, not as an exception
+        out of a bool-contract method."""
+        try:
+            self.load_meta(shuffle_id)
+            return True
+        except (KeyError, ValueError):
+            return False
 
     def delete(self, shuffle_id: int) -> None:
         d = self._dir(shuffle_id)

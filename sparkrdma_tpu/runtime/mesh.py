@@ -43,8 +43,10 @@ def make_mesh(
 
     The 1-D shape matches the reference's flat peer set: SparkRDMA addresses
     every executor by (host, port) with no topology hierarchy. Multi-host and
-    multi-slice topologies still present as one flat axis here; slice-aware
-    hierarchical exchange is layered above (exchange/hierarchical).
+    multi-slice topologies still present as one flat axis here; the staged
+    intra-host/inter-host exchange is selected per shuffle with
+    ``ShuffleConf(transport="hierarchical")``
+    (:mod:`sparkrdma_tpu.exchange.hierarchical`).
     """
     if devices is None:
         devices = jax.devices()

@@ -1,0 +1,221 @@
+"""TPC-DS-shaped multi-join query — BASELINE.md config 3 (q64/q95 shape).
+
+The benchmark queries are shuffle-bound because every join first
+co-partitions both sides across the cluster, and the query ends in a
+grouped aggregate — q64 chains fact ⋈ dim ⋈ dim ... GROUP BY. This
+workload runs that shape through the PUBLIC ShuffleManager API:
+
+  exchange 1   co-partition fact + item dim by item_key; local PK-join
+               attaches item.category to each fact row;
+  exchange 2   re-partition the enriched fact + store dim by store_key;
+               local PK-join looks up store.region, the region filter
+               masks non-qualifying rows' values to 0;
+  exchange 3   re-partition by category with the reader's FUSED
+               ``aggregator="sum"`` (the Spark Aggregator stage inlined
+               into the exchange program): output = unique categories
+               with summed values.
+
+TPU-native design points: dimension joins are primary-key lookups, so
+the join output has the FACT's shape (fixed — no variable-length row
+stream, the XLA-hostile thing); padding rows carry key 0 end-to-end
+(real keys are 1-based) and aggregate into a discarded null group
+instead of needing compaction; each stage's output feeds the next
+``register_shuffle``/``write`` directly as a device-resident columnar
+batch — bytes never leave HBM between stages.
+
+Record layout (W=4): [key_hi=0, key_lo, payload0, payload1].
+  fact:            key=item_key,  payload=(store_key, value)
+  after join 1:    key=store_key, payload=(category, value)
+  after join 2:    key=category,  payload=(masked value, 0)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import weakref
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+from sparkrdma_tpu.utils.compat import shard_map
+from sparkrdma_tpu.utils.stats import barrier
+
+
+@dataclasses.dataclass
+class QueryResult:
+    fact_rows: int
+    groups: int                  # distinct non-null categories in output
+    total_value: int             # sum over qualifying fact rows
+    shuffle_s: float
+    verified: Optional[bool] = None
+
+
+_lookup_cache: "weakref.WeakKeyDictionary[ShuffleManager, Dict[Tuple, Callable]]" \
+    = weakref.WeakKeyDictionary()
+
+
+def _pk_lookup_program(manager: ShuffleManager, cap_f: int, cap_d: int,
+                       mask_with_pred: bool, pred_cutoff: int) -> Callable:
+    """Compiled per-device PK-dimension join.
+
+    fact cols ``[4, cap_f]`` + dim cols ``[4, cap_d]`` -> new fact batch:
+    ``key_lo <- fact.payload0``, ``payload0 <- dim.attr`` (or, with
+    ``mask_with_pred``, ``payload0 <- fact.payload0`` value masked by
+    ``dim.attr < pred_cutoff``). Unmatched/padding rows come out as key 0
+    (the null group).
+    """
+    rt = manager.runtime
+    ax = rt.axis_name
+
+    def local(fc, ft, dc, dt):
+        nf, nd = ft[0], dt[0]
+        vf = jnp.arange(cap_f) < nf
+        vd = jnp.arange(cap_d) < nd
+        # dim sorted by key with attr riding; padding keys to the tail
+        dk = jnp.where(vd, dc[1], jnp.uint32(0xFFFFFFFF))
+        sd, attr = jax.lax.sort((dk, dc[2]), num_keys=1, is_stable=True)
+        fk = fc[1]
+        idx = jnp.searchsorted(sd, fk)
+        idx = jnp.minimum(idx, cap_d - 1)
+        found = (jnp.take(sd, idx) == fk) & vf
+        a = jnp.take(attr, idx)                      # dim attribute
+        next_key = jnp.where(found, fc[2], jnp.uint32(0))
+        if mask_with_pred:
+            qual = found & (a < pred_cutoff)
+            p0 = jnp.where(qual, fc[3], jnp.uint32(0))
+            # carry the key forward: after the filter join the NEXT key
+            # is the carried category (payload0 of the enriched fact)
+            out = jnp.stack([jnp.zeros_like(fk),
+                             jnp.where(found, fc[2], jnp.uint32(0)),
+                             p0, jnp.zeros_like(fk)])
+        else:
+            out = jnp.stack([jnp.zeros_like(fk), next_key,
+                             jnp.where(found, a, jnp.uint32(0)), fc[3]])
+        return out
+
+    return jax.jit(shard_map(
+        local, mesh=rt.mesh,
+        in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+        out_specs=P(None, ax),
+    ))
+
+
+def _lookup(manager, cap_f, cap_d, mask_with_pred, pred_cutoff):
+    cache = _lookup_cache.setdefault(manager, {})
+    key = (cap_f, cap_d, mask_with_pred, pred_cutoff)
+    fn = cache.get(key)
+    if fn is None:
+        fn = _pk_lookup_program(manager, cap_f, cap_d, mask_with_pred,
+                                pred_cutoff)
+        cache[key] = fn
+    return fn
+
+
+def run_q64_shape(
+    manager: ShuffleManager,
+    fact_rows_per_device: int = 256,
+    n_items: int = 256,
+    n_stores: int = 64,
+    n_categories: int = 16,
+    region_cutoff: int = 3,
+    n_regions: int = 8,
+    seed: int = 0,
+    shuffle_ids: Tuple[int, int, int, int, int] = (40, 41, 42, 43, 44),
+    verify: bool = True,
+) -> QueryResult:
+    """Run the 3-exchange query; verify grouped sums against numpy."""
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    rng = np.random.default_rng(seed)
+    nf = mesh * fact_rows_per_device
+
+    # --- tables (1-based keys; 0 is the null/padding key) --------------
+    fact = np.zeros((nf, 4), dtype=np.uint32)
+    fact[:, 1] = rng.integers(1, n_items + 1, size=nf)        # item_key
+    fact[:, 2] = rng.integers(1, n_stores + 1, size=nf)       # store_key
+    fact[:, 3] = rng.integers(1, 100, size=nf)                # value
+
+    item = np.zeros((max(mesh, n_items), 4), dtype=np.uint32)
+    item[:n_items, 1] = np.arange(1, n_items + 1)             # PK
+    item[:n_items, 2] = rng.integers(1, n_categories + 1, size=n_items)
+
+    store = np.zeros((max(mesh, n_stores), 4), dtype=np.uint32)
+    store[:n_stores, 1] = np.arange(1, n_stores + 1)          # PK
+    store[:n_stores, 2] = rng.integers(0, n_regions, size=n_stores)
+
+    part = hash_partitioner(mesh, manager.conf.key_words)
+    sids = list(shuffle_ids)
+    t0 = time.perf_counter()
+
+    def co_partition(sid, records):
+        handle = manager.register_shuffle(sid, mesh, part)
+        writer = manager.get_writer(handle).write(records)
+        writer.stop(True)
+        out, totals = manager.get_reader(handle).read(record_stats=False)
+        return handle, out, totals, writer.plan.out_capacity
+
+    # exchange 1: fact + item by item_key ------------------------------
+    _, f1, tf1, capf1 = co_partition(sids[0], rt.shard_records(fact))
+    _, d1, td1, capd1 = co_partition(sids[1], rt.shard_records(item))
+    enriched = _lookup(manager, capf1, capd1, False, 0)(f1, tf1, d1, td1)
+    manager.unregister_shuffle(sids[0])
+    manager.unregister_shuffle(sids[1])
+
+    # exchange 2: enriched fact + store by store_key -------------------
+    _, f2, tf2, capf2 = co_partition(sids[2], enriched)
+    _, d2, td2, capd2 = co_partition(sids[3], rt.shard_records(store))
+    filtered = _lookup(manager, capf2, capd2, True,
+                       region_cutoff)(f2, tf2, d2, td2)
+    manager.unregister_shuffle(sids[2])
+    manager.unregister_shuffle(sids[3])
+
+    # exchange 3: group by category, fused sum aggregation -------------
+    handle = manager.register_shuffle(sids[4], mesh, part)
+    writer = manager.get_writer(handle).write(filtered)
+    writer.stop(True)
+    gout, gtot = manager.get_reader(handle,
+                                    aggregator="sum").read()
+    barrier(gout)
+    shuffle_s = time.perf_counter() - t0
+
+    cap = writer.plan.out_capacity
+    go, gt = np.asarray(gout), np.asarray(gtot)
+    groups: Dict[int, int] = {}
+    for d in range(mesh):
+        k = int(gt[d])
+        dev = go[:, d * cap:d * cap + k]
+        for j in range(k):
+            key = int(dev[1, j])
+            if key:                                  # drop the null group
+                groups[key] = groups.get(key, 0) + int(dev[2, j])
+    manager.unregister_shuffle(sids[4])
+
+    verified = None
+    if verify:
+        cat_of = {int(item[i, 1]): int(item[i, 2]) for i in range(n_items)}
+        reg_of = {int(store[i, 1]): int(store[i, 2])
+                  for i in range(n_stores)}
+        ref: Dict[int, int] = {}
+        for i in range(nf):
+            cat = cat_of[int(fact[i, 1])]
+            qualifies = reg_of[int(fact[i, 2])] < region_cutoff
+            ref[cat] = ref.get(cat, 0) + (int(fact[i, 3]) if qualifies
+                                          else 0)
+        verified = groups == ref
+
+    return QueryResult(
+        fact_rows=nf,
+        groups=len(groups),
+        total_value=sum(groups.values()),
+        shuffle_s=shuffle_s,
+        verified=verified,
+    )
+
+
+__all__ = ["run_q64_shape", "QueryResult"]

@@ -11,6 +11,7 @@ import sys
 
 def main() -> int:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    spill_dir = sys.argv[4] if len(sys.argv) > 4 else ""
     from sparkrdma_tpu.runtime.distributed import initialize_distributed
 
     assert initialize_distributed(
@@ -51,8 +52,43 @@ def main() -> int:
     got = global_scalar(totals)
     assert got == 32 * mesh_size, f"conservation: {got}"
 
-    # global order across the process boundary: gather each device's
-    # first valid key (replicated min/max path)
+    # hierarchical (intra-host + DCN) transport parity across the real
+    # process boundary: same records, same totals as the flat transport
+    from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+
+    hconf = conf.replace(transport="hierarchical")
+    hmanager = ShuffleManager(MeshRuntime(hconf), hconf)
+    part = modulo_partitioner(8, key_word=1)
+    rng = np.random.default_rng(11)
+    xh = rng.integers(1, 2**32, size=(mesh_size * 16, 4), dtype=np.uint32)
+    hh = hmanager.register_shuffle(5, 8, part)
+    hmanager.get_writer(hh).write(
+        hmanager.runtime.shard_records(xh)).stop(True)
+    hout, htot = hmanager.get_reader(hh).read()
+    assert global_scalar(htot) == xh.shape[0], "hierarchical conservation"
+    hmanager.stop()
+
+    # multi-host sharded checkpoint: every process spills only its own
+    # shards; a fresh manager resumes across the process boundary
+    if spill_dir:
+        cconf = conf.replace(spill_to_host=True, spill_dir=spill_dir)
+        m1 = ShuffleManager(MeshRuntime(cconf), cconf)
+        xc = rng.integers(1, 2**32, size=(mesh_size * 16, 4),
+                          dtype=np.uint32)
+        hc = m1.register_shuffle(7, 8, part)
+        m1.get_writer(hc).write(m1.runtime.shard_records(xc)).stop(True)
+        ref = global_scalar(m1.get_reader(hc).read()[1])
+        m1._writers.clear()
+        m1.runtime.stop()
+
+        m2 = ShuffleManager(MeshRuntime(cconf), cconf)
+        hc2 = m2.register_shuffle(7, 8, part)
+        m2.resume_shuffle(hc2)
+        got = global_scalar(m2.get_reader(hc2).read()[1])
+        assert got == ref == xc.shape[0], f"resume conservation: {got}"
+        m2.stop()
+        print(f"MPCKPT proc={pid} ok", flush=True)
+
     manager.stop()
     print(f"MPOK proc={pid} mesh={mesh_size}", flush=True)
     return 0

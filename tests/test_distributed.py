@@ -26,7 +26,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_shuffle():
+def test_two_process_shuffle(tmp_path):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
@@ -36,10 +36,11 @@ def test_two_process_shuffle():
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
         "PALLAS_AXON_POOL_IPS": "",
     })
+    spill = str(tmp_path / "mp_ckpt")
     procs = [
         subprocess.Popen(
             [sys.executable, str(REPO / "tests" / "mp_worker.py"),
-             str(pid), "2", str(port)],
+             str(pid), "2", str(port), spill],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -57,3 +58,4 @@ def test_two_process_shuffle():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"MPOK proc={pid} mesh=8" in out, out
+        assert f"MPCKPT proc={pid} ok" in out, out

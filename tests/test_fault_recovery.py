@@ -214,3 +214,58 @@ def test_skew_split_shuffle_resumes_from_checkpoint(tmp_path, rng):
         assert resumed.split_factor == plan.split_factor
         assert np.array_equal(np.asarray(totals), ref_tot)
         assert np.array_equal(np.asarray(out), ref_out)
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path, rng):
+    """Sharded (multi-host layout) checkpoints: per-shard save, complete
+    -ness gating, and resume through the manager's sharded reload path."""
+    from sparkrdma_tpu.meta.checkpoint import MapOutputStore
+
+    conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                       spill_dir=str(tmp_path / "sharded"))
+    part = modulo_partitioner(8, key_word=1)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        handle = m.register_shuffle(30, 8, part)
+        x = _write(m, handle, rng)
+        writer = m._writers[30]
+        ref_out, ref_tot = map(np.asarray, m.get_reader(handle).read())
+
+        # re-save the same map output in the SHARDED layout (what each
+        # process of a multi-host job would persist for its own devices)
+        store = MapOutputStore(str(tmp_path / "sharded2"))
+        n = writer.records.shape[1]
+        shard_len = n // 8
+        shards = [(c, np.asarray(writer.records)[:, c * shard_len:
+                                                 (c + 1) * shard_len])
+                  for c in range(8)]
+        store.save_shards(30, shards, writer.plan, 8,
+                          writer.records.shape, 0, 1)
+        assert store.contains(30)
+
+        m2 = ShuffleManager(MeshRuntime(conf), conf, store=store)
+        try:
+            h2 = m2.register_shuffle(30, 8, part)
+            m2.resume_shuffle(h2)
+            out2, tot2 = m2.get_reader(h2).read()
+            assert np.array_equal(np.asarray(tot2), ref_tot)
+            assert np.array_equal(np.asarray(out2), ref_out)
+        finally:
+            m2._registry.unregister(30)
+            m2.runtime.stop()
+
+
+def test_sharded_checkpoint_incomplete_not_resumable(tmp_path, rng):
+    """A torn sharded save (missing a process marker) must read as
+    absent, not resume half a map output."""
+    from sparkrdma_tpu.meta.checkpoint import MapOutputStore
+    from sparkrdma_tpu.exchange.protocol import ShufflePlan
+
+    store = MapOutputStore(str(tmp_path / "torn"))
+    plan = ShufflePlan(counts=np.ones((8, 8), np.int64), num_rounds=1,
+                       out_capacity=8, capacity=8)
+    shards = [(0, np.zeros((4, 8), np.uint32))]
+    # claim 2 processes but only proc 0 ever writes its marker
+    store.save_shards(31, shards, plan, 8, (4, 64), 0, 2)
+    assert not store.contains(31)
+    with pytest.raises(KeyError, match="incomplete"):
+        store.load_meta(31)

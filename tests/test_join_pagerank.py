@@ -56,3 +56,32 @@ def test_pagerank_star_graph(manager):
     res = run_pagerank(manager.runtime, edges, v, iterations=10)
     assert res.verified
     assert res.ranks[0] == res.ranks.max()
+
+
+class TestQ64Shape:
+    """The TPC-DS q64-shaped query (BASELINE config 3): three chained
+    co-partitioning exchanges + PK-dim joins + fused group-by, verified
+    against a numpy reference of the full query."""
+
+    def test_q64_shape_matches_numpy(self, manager):
+        from sparkrdma_tpu.workloads.tpcds import run_q64_shape
+
+        res = run_q64_shape(manager, fact_rows_per_device=128,
+                            verify=True)
+        assert res.verified, "grouped sums differ from numpy reference"
+        assert res.fact_rows == 8 * 128
+        assert res.groups > 0
+
+    def test_q64_filter_selectivity(self, manager):
+        """cutoff=0 filters everything: all groups sum to zero; a full
+        cutoff keeps every row."""
+        from sparkrdma_tpu.workloads.tpcds import run_q64_shape
+
+        none = run_q64_shape(manager, fact_rows_per_device=64,
+                             region_cutoff=0, shuffle_ids=(50, 51, 52,
+                                                           53, 54))
+        assert none.verified and none.total_value == 0
+        full = run_q64_shape(manager, fact_rows_per_device=64,
+                             region_cutoff=8, shuffle_ids=(55, 56, 57,
+                                                           58, 59))
+        assert full.verified and full.total_value > 0
