@@ -587,14 +587,24 @@ class ShuffleManager:
 
             ax = self.runtime.axis_name
 
+            from sparkrdma_tpu.kernels.merge_sort import (merge_sort_cols,
+                                                          supports_fast_sort)
+
+            fast = (self.conf.fast_sort
+                    and supports_fast_sort(cap, self.conf.fast_sort_run))
+
             def local_sort(cols, total):
                 valid = jnp.arange(cap) < total[0]
+                if fast:   # same contract note as the fused tail
+                    return merge_sort_cols(cols, valid,
+                                           run=self.conf.fast_sort_run)
                 return lexsort_cols(cols, key_words, valid)
 
             fn = jax.jit(shard_map(
                 local_sort, mesh=self.runtime.mesh,
                 in_specs=(P(None, ax), P(ax)),
                 out_specs=P(None, ax),
+                check_vma=not fast,   # pallas kernels defeat VMA typing
             ))
             self._sort_cache[key] = fn
         return fn(out, totals)

@@ -94,6 +94,22 @@ class ShuffleConf:
     #: mesh's process set (devices per host = mesh size / processes)
     hierarchy_hosts: int = 0
 
+    # --- reduce-side sort ---
+    #: use the Pallas merge-path sort for fused key-ordering when the
+    #: geometry allows (power-of-two output >= 2 runs). It orders by the
+    #: FULL record (key words first, payload words break ties) and is
+    #: not stable. Default OFF: measured on v5e at 16M x 16B records the
+    #: kernel's in-VMEM merge network (~40ms/stage) loses to lax.sort's
+    #: own fused stages (~6.6ms/doubling; scripts/profile7.py) — XLA's
+    #: sort is already near the bitonic bandwidth floor on this
+    #: hardware. The kernel is kept correct + tested as the scaffold for
+    #: later-generation tuning; opt in to measure.
+    fast_sort: bool = False
+    #: initial run length for the merge-path sort (power of two). The
+    #: default suits real record counts; tests lower it to exercise the
+    #: fast path at CPU-mesh sizes.
+    fast_sort_run: int = 1 << 15
+
     # --- observability ---
     collect_shuffle_read_stats: bool = False
 
@@ -115,6 +131,11 @@ class ShuffleConf:
             raise ValueError("round counts must be positive")
         if self.transport not in ("xla", "pallas_ring", "hierarchical"):
             raise ValueError(f"unknown transport {self.transport!r}")
+        if (self.fast_sort_run < 128
+                or self.fast_sort_run & (self.fast_sort_run - 1)):
+            raise ValueError(
+                "fast_sort_run must be a power of two >= 128 (the "
+                f"lane-width tile minimum), got {self.fast_sort_run}")
         if self.hierarchy_hosts < 0:
             raise ValueError("hierarchy_hosts must be >= 0")
         _parse_prealloc(self.prealloc)  # validate eagerly

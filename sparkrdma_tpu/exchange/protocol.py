@@ -321,6 +321,18 @@ class ShuffleExchange:
 
         return a2a
 
+    def _uses_fast_sort(self, out_capacity: int, sort_key_words: int,
+                        aggregator: str) -> bool:
+        """Will the fused tail run the Pallas merge-path sort? (Programs
+        embedding it must disable vma checking, like the ring transport —
+        pallas kernels mix varying refs with unvarying grid indices.)"""
+        from sparkrdma_tpu.kernels.merge_sort import supports_fast_sort
+
+        return (bool(sort_key_words) and not aggregator
+                and self.conf.fast_sort
+                and supports_fast_sort(out_capacity,
+                                       self.conf.fast_sort_run))
+
     def _fuse_tail(self, out, total, out_capacity, sort_key_words,
                    aggregator, float_payload, tight_out=False):
         """Optional fused reduce-side stages (sort / combine-by-key).
@@ -336,11 +348,21 @@ class ShuffleExchange:
             out, total = combine_by_key_cols(
                 out, valid, self.conf.key_words, aggregator, float_payload)
         elif sort_key_words:
+            from sparkrdma_tpu.kernels.merge_sort import merge_sort_cols
             from sparkrdma_tpu.kernels.sort import lexsort_cols
 
             valid = (None if tight_out
                      else jnp.arange(out_capacity) < total)
-            out = lexsort_cols(out, sort_key_words, valid)
+            if self._uses_fast_sort(out_capacity, sort_key_words,
+                                    aggregator):
+                # Pallas merge-path sort: full-record order (sorted by
+                # the key words; payload words break ties), not stable —
+                # the ExternalSorter contract Spark actually gives for
+                # sortByKey. Stability needed? conf.fast_sort=False.
+                out = merge_sort_cols(out, valid,
+                                      run=self.conf.fast_sort_run)
+            else:
+                out = lexsort_cols(out, sort_key_words, valid)
         return out, total
 
     # ------------------------------------------------------------------
@@ -437,9 +459,12 @@ class ShuffleExchange:
                 mesh=self.mesh,
                 in_specs=tuple(in_specs),
                 out_specs=(P(None, ax), P(ax), P(ax)),
-                # VMA inference cannot type the pallas kernel's varying
-                # device-id arithmetic; the xla transport keeps the check
-                check_vma=(self.conf.transport == "xla"),
+                # VMA inference cannot type pallas kernels (ring
+                # transport's device-id arithmetic, merge-sort's grid
+                # indices); pure-XLA programs keep the check
+                check_vma=(self.conf.transport == "xla"
+                           and not self._uses_fast_sort(
+                               out_capacity, sort_key_words, aggregator)),
             ),
             donate_argnums=((1,) if donate_out else ()),
         )
@@ -616,6 +641,8 @@ class ShuffleExchange:
             local_tail, mesh=self.mesh,
             in_specs=(P(None, ax), P(ax)),
             out_specs=(P(None, ax), P(ax)),
+            check_vma=not self._uses_fast_sort(out_capacity,
+                                               sort_key_words, aggregator),
         ))
 
     def _exchange_streaming(self, records, partitioner, plan, num_parts,

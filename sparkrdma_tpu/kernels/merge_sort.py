@@ -20,6 +20,17 @@ is a TPU-native two-phase sort over columnar records ``uint32[W, N]``:
    concatenation is bitonic), and writes the first ``T`` — a linear merge
    at HBM bandwidth instead of ``lax.sort``'s O(log^2) global passes.
 
+MEASURED STATUS (v5e, 16M x 16B records, scripts/profile7.py): correct
+compiled and in interpret mode, but slower than monolithic ``lax.sort``
+(~387ms vs ~82ms): each stage's HBM traffic is indeed ~2 scans, but the
+in-VMEM bitonic merge network (reverse 17 + merge 17 passes over the
+2T-candidate buffer) costs ~40ms/stage, while XLA's own sort spends only
+~6.6ms per run-doubling — its register-resident network is already near
+the hardware's bitonic floor. The kernel therefore ships OPT-IN
+(``ShuffleConf(fast_sort=True)``), fully tested, as the scaffold for
+future tuning (fewer VMEM passes via Batcher merge without the reversal,
+key-only networks with rank-based payload placement).
+
 Records compare lexicographically over ALL ``W`` words (keys lead, payload
 words break ties). Total order up to identical records makes every
 merge-path split multiset-exact — no stability bookkeeping is needed, and
@@ -155,6 +166,9 @@ def chunk_sort_cols(cols: jax.Array, run: int) -> jax.Array:
 # ----------------------------------------------------------------------
 # merge-path diagonal search (XLA, vectorized over all tiles of a stage)
 # ----------------------------------------------------------------------
+_Q = 128   # merge-path refinement quantum (the lane width)
+
+
 def _merge_path_offsets(cols: jax.Array, n: int, run: int, tile: int) -> jax.Array:
     """For each output tile, how many of its pair's A-run elements precede
     the tile's diagonal — int32[n_tiles].
@@ -163,8 +177,17 @@ def _merge_path_offsets(cols: jax.Array, n: int, run: int, tile: int) -> jax.Arr
     tpp) * tile``. The returned ``a`` satisfies: the first ``d`` merged
     elements are exactly ``A[:a] ∪ B[:d-a]`` under the full-record total
     order (ties split arbitrarily — harmless, see module docstring).
-    Classic merge-path binary search, vectorized over every tile at once
-    (the gathers are ~n_tiles*W elements — negligible).
+
+    TPU cost shaping: gathers scan their OPERAND, so a classic binary
+    search (log R serialized gather trips over the full array) costs
+    ~20ms/stage at 16M records (measured). Instead: (1) a coarse search
+    over 128-strided samples — a ~N/128 operand, gathers nearly free —
+    finds ``qa = floor(a*/128)`` exactly, because the feasibility
+    predicate ``A[a-1] <= B[d-a]`` at 128-multiple ``a`` touches only
+    ``A[127 mod 128]`` and ``B[0 mod 128]`` positions (diagonals are
+    128-multiples); (2) ONE batched gather pulls each tile's 128-wide
+    refinement windows and a vectorized predicate+popcount finishes
+    exactly. Two scans of the big operand total, instead of log R.
     """
     w = cols.shape[0]
     tpp = (2 * run) // tile                   # tiles per pair
@@ -175,55 +198,114 @@ def _merge_path_offsets(cols: jax.Array, n: int, run: int, tile: int) -> jax.Arr
     pair = jnp.arange(n_tiles, dtype=jnp.int32) // tpp
     d = (jnp.arange(n_tiles, dtype=jnp.int32) % tpp) * tile
 
-    lo = jnp.maximum(0, d - run)              # a in [lo, hi]
-    hi = jnp.minimum(d, run)
+    # data-derived zero keeps the fori_loop carry's varying-manual-axes
+    # type consistent under shard_map (constant init would be unvarying)
+    vz = (cols[0, 0] & jnp.uint32(0)).astype(jnp.int32)
+    lo = jnp.maximum(0, d - run) + vz         # a in [lo, hi]
+    hi = jnp.minimum(d, run) + vz
 
-    def gather(words, p, idx):
-        # words: [W, n_pairs, 2R]; p, idx: [n_tiles] -> W x [n_tiles]
+    # ---- phase 1: coarse search on strided samples -------------------
+    # sa127[q] = A[q*128 + 127], sb0[q] = B[q*128]; the predicate at
+    # a = qa*128 is  A[qa*128 - 1] <= B[d - qa*128]  =
+    #               sa127[qa - 1]  <= sb0[(d - a) / 128]
+    nq = run // _Q
+    sa127 = [runs[i][:, _Q - 1:run:_Q] for i in range(w)]  # [n_pairs, nq]
+    sb0 = [runs[i][:, run::_Q] for i in range(w)]
+
+    qlo = lo // _Q                            # qa in [qlo, qhi]
+    qhi = hi // _Q
+
+    def qgather(words, p, idx):
         return [words[i][p, idx] for i in range(w)]
 
-    def body(_, lohi):
-        lo, hi = lohi
-        a = (lo + hi + 1) // 2                # candidate: A contributes a
-        # feasible iff A[a-1] <= B[d-a]  (a > lo guarantees a >= 1 and
-        # d - a < hi' bounds keep indices legal after clamping)
-        ai = jnp.clip(a - 1, 0, run - 1)
-        bi = jnp.clip(d - a, 0, run - 1)
-        a_vals = gather(runs, pair, ai)
-        b_vals = gather(runs, pair, run + bi)
-        # A[a-1] <= B[d-a]  <=>  not (B < A)
-        ok = ~_lex_lt(b_vals, a_vals)
-        # positions where d - a == run would index B out of range; then B
-        # is exhausted below the diagonal and a must be at least d - run
-        # (already enforced by lo); where a - 1 < 0 the predicate is
-        # trivially true (clip handles the index; a == lo skips via mask)
-        ok = ok | (a - 1 < 0)
-        new_lo = jnp.where(ok, a, lo)
-        new_hi = jnp.where(ok, hi, a - 1)
-        return new_lo, new_hi
+    def qbody(_, lohi):
+        qlo, qhi = lohi
+        qa = (qlo + qhi + 1) // 2
+        a = qa * _Q
+        ai = jnp.clip(qa - 1, 0, nq - 1)
+        bi = jnp.clip((d - a) // _Q, 0, nq - 1)
+        a_vals = qgather(sa127, pair, ai)
+        b_vals = qgather(sb0, pair, bi)
+        ok = ~_lex_lt(b_vals, a_vals)         # A[a-1] <= B[d-a]
+        ok = ok | (qa <= 0)
+        # d - a == run (B exhausted below diagonal) only at qa == qlo,
+        # which the search never probes (midpoint > qlo)
+        new_qlo = jnp.where(ok, qa, qlo)
+        new_qhi = jnp.where(ok, qhi, qa - 1)
+        return new_qlo, new_qhi
 
-    # fixed-trip binary search: ceil(log2(run)) + 1 covers the range
-    trips = max(1, int(math.log2(max(2, run))) + 2)
-    lo, hi = lax.fori_loop(0, trips, body, (lo, hi))
-    return lo.astype(jnp.int32)
+    trips = max(1, int(math.log2(max(2, nq))) + 2)
+    qlo, qhi = lax.fori_loop(0, trips, qbody, (qlo, qhi))
+    a0 = jnp.clip(qlo * _Q, lo, hi)           # a* in [a0, a0 + 128]
+
+    # ---- phase 2: exact refinement, one batched gather ---------------
+    # predicate for a = a0 + k (k = 1..128):  A[a0 + k - 1] <= B[d - a0
+    # - k]; A window = A[a0 : a0 + 128], B window = B[d - a0 - 128 :
+    # d - a0] — both 128-contiguous. One flat take() per word gathers
+    # every tile's two windows in a single operand scan.
+    flat = [runs[i].reshape(-1) for i in range(w)]   # [n_pairs * 2R]
+    k = jnp.arange(_Q, dtype=jnp.int32)[None, :]     # [1, 128]
+    base_pair = pair * (2 * run)
+    a_idx = base_pair[:, None] + jnp.clip(a0[:, None] + k, 0, run - 1)
+    b_off = jnp.clip(d[:, None] - a0[:, None] - _Q + k, 0, run - 1)
+    b_idx = base_pair[:, None] + run + b_off
+    idx = jnp.concatenate([a_idx, b_idx], axis=1).reshape(-1)
+    vals = [jnp.take(flat[i], idx, axis=0).reshape(n_tiles, 2 * _Q)
+            for i in range(w)]
+    awin = [v[:, :_Q] for v in vals]                 # A[a0 + k]
+    bwin = [v[:, _Q:] for v in vals]                 # B[d - a0 - 128 + k]
+    # feasible(a0 + k) for k>=1:  A[a0+k-1] <= B[d-a0-k]
+    # = awin[k-1] <= bwin[128 - k]  -> align: compare awin[j] (j=k-1)
+    # with bwin reversed at j: brev[j] = bwin[127 - j]
+    brev = [v[:, ::-1] for v in bwin]
+    ok = ~_lex_lt(brev, awin)                        # [n_tiles, 128]
+    # guard k beyond the true range [lo, hi]
+    kk = a0[:, None] + 1 + jnp.arange(_Q, dtype=jnp.int32)[None, :]
+    ok = ok & (kk <= hi[:, None])
+    # clipped A-indices (a0 + k - 1 > run-1) mean A exhausted: infeasible
+    ok = ok & ((a0[:, None] + jnp.arange(_Q)[None, :]) <= run - 1)
+    # feasibility is monotone in k: a* = a0 + count of feasible k
+    a_star = a0 + jnp.sum(ok.astype(jnp.int32), axis=1)
+    return jnp.clip(a_star, lo, hi).astype(jnp.int32)
 
 
 # ----------------------------------------------------------------------
 # the per-stage Pallas kernel
 # ----------------------------------------------------------------------
-def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
-                  *, run, tile, w):
+def _window(cols_ref, win, tail, sems, start_aligned, shift, tile, w):
+    """DMA an aligned ``[W, tile]`` window + its 128-wide tail, then
+    realign to the true (unaligned) start entirely in VMEM.
+
+    Mosaic constraints shape this: HBM DMA offsets must be 128-aligned,
+    and ``pltpu.roll`` with a DYNAMIC shift is only correct on
+    power-of-two lane lengths (measured: wrong on tile+128). So the
+    window loads as two aligned pieces, each pow2-rolled, stitched with
+    an iota select: out[j] = cols[start_aligned + shift + j] for
+    j < tile.
+    """
+    cp_w = pltpu.make_async_copy(
+        cols_ref.at[:, pl.ds(start_aligned, tile)], win, sems[0])
+    cp_t = pltpu.make_async_copy(
+        cols_ref.at[:, pl.ds(start_aligned + tile, 128)], tail, sems[1])
+    cp_w.start()
+    cp_t.start()
+    cp_w.wait()
+    cp_t.wait()
+    main = pltpu.roll(win[...], shift=-shift, axis=1)
+    tail_pad = jnp.concatenate(
+        [tail[...], jnp.zeros((w, tile - 128), jnp.uint32)], axis=1)
+    tail_shifted = pltpu.roll(tail_pad, shift=tile - shift, axis=1)
+    iota = lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+    return jnp.where(iota < tile - shift, main, tail_shifted)
+
+
+def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, a_tail, b_win,
+                  b_tail, sem_a, sem_at, sem_b, sem_bt, *, run, tile, w):
     """One output tile of one merge stage.
 
     ``cols_ref``: the full padded array [W, n + 2*tile] in HBM/ANY.
     ``out_ref``: VMEM block [W, tile] at tile t.
-    ``a_win/b_win``: VMEM scratch [W, tile + 128].
-
-    HBM DMA offsets must be 128-lane aligned (Mosaic tiling), but the
-    merge-path offsets ``a``/``b`` are arbitrary — so each window loads
-    ``tile + 128`` from the aligned floor, a dynamic lane-roll shifts
-    the misalignment out, and a static slice keeps the first ``tile``
-    genuine elements.
+    ``a_win/b_win``: VMEM scratch [W, tile]; ``*_tail``: [W, 128].
     """
     n_tiles = pl.num_programs(0) - 2          # grid has two pad tiles
     t_raw = pl.program_id(0)
@@ -242,18 +324,14 @@ def _stage_kernel(aoff_ref, cols_ref, out_ref, a_win, b_win, sem_a, sem_b,
     sa = a & 127
     sb = b & 127
 
-    cp_a = pltpu.make_async_copy(
-        cols_ref.at[:, pl.ds(base + (a - sa), tile + 128)], a_win, sem_a)
-    cp_b = pltpu.make_async_copy(
-        cols_ref.at[:, pl.ds(base + run + (b - sb), tile + 128)],
-        b_win, sem_b)
-    cp_a.start()
-    cp_b.start()
-    cp_a.wait()
-    cp_b.wait()
-
-    wa = pltpu.roll(a_win[...], shift=-sa, axis=1)[:, :tile]
-    wb = pltpu.roll(b_win[...], shift=-sb, axis=1)[:, :tile]
+    # pl.multiple_of: the 128-alignment of (a - sa) is arithmetic fact,
+    # not something Mosaic's divisibility prover can see through & 127
+    a_start = pl.multiple_of(base + (a - sa), 128)
+    b_start = pl.multiple_of(base + run + (b - sb), 128)
+    wa = _window(cols_ref, a_win, a_tail, (sem_a, sem_at), a_start, sa,
+                 tile, w)
+    wb = _window(cols_ref, b_win, b_tail, (sem_b, sem_bt), b_start, sb,
+                 tile, w)
 
     iota = lax.broadcasted_iota(jnp.int32, (1, tile), 1)  # 2D for Mosaic
     a_valid = iota < (run - a)                           # rest of A-run
@@ -286,8 +364,12 @@ def _merge_stage(cols_padded: jax.Array, aoff: jax.Array, *, n: int,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((w, tile), lambda t, aoff: (0, t)),
         scratch_shapes=[
-            pltpu.VMEM((w, tile + 128), jnp.uint32),
-            pltpu.VMEM((w, tile + 128), jnp.uint32),
+            pltpu.VMEM((w, tile), jnp.uint32),
+            pltpu.VMEM((w, 128), jnp.uint32),
+            pltpu.VMEM((w, tile), jnp.uint32),
+            pltpu.VMEM((w, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],

@@ -91,8 +91,7 @@ def _pk_lookup_program(manager: ShuffleManager, cap_f: int, cap_d: int,
             p0 = jnp.where(qual, fc[3], jnp.uint32(0))
             # carry the key forward: after the filter join the NEXT key
             # is the carried category (payload0 of the enriched fact)
-            out = jnp.stack([jnp.zeros_like(fk),
-                             jnp.where(found, fc[2], jnp.uint32(0)),
+            out = jnp.stack([jnp.zeros_like(fk), next_key,
                              p0, jnp.zeros_like(fk)])
         else:
             out = jnp.stack([jnp.zeros_like(fk), next_key,

@@ -88,3 +88,32 @@ def test_supports_fast_sort_gate():
     assert supports_fast_sort(1 << 20)
     assert not supports_fast_sort((1 << 20) - 4)   # not pow2
     assert not supports_fast_sort(1 << 14)         # fewer than 2 runs
+
+
+def test_fast_sort_fused_in_exchange(rng):
+    """End to end: TeraSort through the public API with the Pallas
+    merge-path sort active in the fused exchange tail (fast_sort_run
+    lowered so the CPU mesh geometry qualifies), full host permutation
+    proof."""
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    conf = ShuffleConf(slot_records=4096, fast_sort=True,
+                       fast_sort_run=128)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        res, out, totals = run_terasort(m, records_per_device=512,
+                                        warmup=False, verify=True)
+        assert res.verified, "fast-sort terasort failed global-sort proof"
+
+
+def test_fast_sort_disabled_falls_back(rng):
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    conf = ShuffleConf(slot_records=4096, fast_sort=False)
+    with ShuffleManager(MeshRuntime(conf), conf) as m:
+        res, _, _ = run_terasort(m, records_per_device=256, warmup=False,
+                                 verify=True)
+        assert res.verified
