@@ -18,8 +18,19 @@ the host-side permutation proof that tests/ run at test scale.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Env knobs: BENCH_RECORDS_PER_DEVICE (default 16M ~= 256MB/chip),
-BENCH_REPEATS (default 8).
+Env knobs: BENCH_RECORDS_PER_DEVICE (default 32M ~= 512MB/chip),
+BENCH_REPEATS (default 8), BENCH_RECORD_WORDS (default 4 = 16B records:
+2-word key + 2-word payload).
+
+Measured context (v5e, scripts/profile5-7 + /tmp sweeps, round 3): the
+per-iteration cost decomposes into ~13ms dispatch + ~2ms degenerate-
+path framing + the lax.sort, which is the floor: 77-82ms at 16M x 4
+words (3.3 GB/s sort-only). GB/s rises with record WIDTH (key-compare
+depth amortizes over more bytes): 52B records sort at 5.09 GB/s, and
+HiBench-faithful 100B records would score higher still but their
+25-operand variadic sort takes ~14min to compile over the tunnel —
+unusable for a driver-run bench, so the headline stays at W=4, the
+hardest-per-byte config.
 """
 
 import json
@@ -28,9 +39,12 @@ import sys
 
 
 def main() -> int:
+    # default 32M records = 512MB/chip: the log^2 sort amortizes better
+    # over larger batches (measured 2.27 vs 2.10 GB/s at 256MB)
     records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
-                                            16 * 1024 * 1024))
+                                            32 * 1024 * 1024))
     repeats = int(os.environ.get("BENCH_REPEATS", 8))
+    record_words = int(os.environ.get("BENCH_RECORD_WORDS", 4))
     import jax
 
     from sparkrdma_tpu import MeshRuntime, ShuffleConf
@@ -45,6 +59,7 @@ def main() -> int:
     conf = ShuffleConf(slot_records=slot,
                        max_rounds=64,
                        max_slot_records=max(1 << 22, 2 * slot),
+                       val_words=record_words - 2,
                        collect_shuffle_read_stats=False)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:

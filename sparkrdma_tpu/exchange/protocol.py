@@ -393,8 +393,30 @@ class ShuffleExchange:
         data_a2a = self._data_a2a()
 
         def local_step(records, *maybe_buf):
-            # --- map side: bucket into per-partition runs -------------
             # records: columnar [W, n_local]
+            if num_parts == 1 and num_rounds == 1 and mesh_size == 1:
+                # degenerate exchange (single partition, single chip):
+                # the slot/window/compact machinery is the identity here
+                # — every record stays put — so skip its ~6 full-array
+                # copies and run the fused tail on the batch directly
+                # (the 1-chip bench's hot path; same spirit as
+                # bucket_records' num_parts==1 short-circuit)
+                n_local = records.shape[1]
+                total = jnp.full((), n_local, jnp.int32)
+                incoming = jnp.full((1, 1), n_local, jnp.int32)
+                out = records
+                if out_capacity != n_local:
+                    out = jnp.pad(records,
+                                  ((0, 0), (0, out_capacity - n_local)))
+                out, total = self._fuse_tail(out, total, out_capacity,
+                                             sort_key_words, aggregator,
+                                             float_payload, tight_out)
+                if maybe_buf:
+                    out = lax.dynamic_update_slice(maybe_buf[0], out,
+                                                   (0, 0))
+                return out, total[None], incoming[None]
+
+            # --- map side: bucket into per-partition runs -------------
             pids = partitioner(records).astype(jnp.int32)
             sr, counts, offs = bucket_records(records, pids, num_parts)
 

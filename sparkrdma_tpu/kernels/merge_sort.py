@@ -421,6 +421,11 @@ def merge_sort_cols(
     w, n = cols.shape
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if run < _Q or run & (run - 1):
+        # the coarse search's 128-quantum and the window-tail stitch
+        # both assume a pow2 run of at least one lane group
+        raise ValueError(
+            f"run must be a power of two >= {_Q}, got {run}")
     if not supports_fast_sort(n, run):
         raise ValueError(
             f"merge_sort_cols needs power-of-two N >= {2*run}, got {n}")

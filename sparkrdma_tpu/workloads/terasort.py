@@ -252,7 +252,10 @@ def run_terasort(
 
         reader = manager.get_reader(handle, key_ordering=True)
         if warmup:
-            jax.block_until_ready(reader.read(record_stats=False)[0])
+            # barrier, not block_until_ready: the latter does not block
+            # through the axon tunnel, which would leak the warmup
+            # execution into the timed region
+            barrier(reader.read(record_stats=False)[0])
         t0 = time.perf_counter()
         for _ in range(repeats - 1):
             # steady state: each read is a complete exchange+sort; the

@@ -334,3 +334,32 @@ class TestHierarchicalTransport:
         _, rt = exchange
         with pytest.raises(ValueError, match="divide"):
             hierarchy_for(rt.mesh, rt.axis_name, 3)
+
+
+def test_single_device_degenerate_exchange(rng):
+    """mesh=1, num_parts=1: the short-circuited exchange (no slot
+    machinery) must still deliver every record and honor the fused sort
+    — this is the 1-chip bench's hot path."""
+    import jax
+
+    from sparkrdma_tpu import MeshRuntime
+
+    conf = ShuffleConf(slot_records=1 << 20)
+    rt = MeshRuntime(conf, devices=jax.devices()[:1])
+    try:
+        ex = ShuffleExchange(rt.mesh, rt.axis_name, conf, pool=rt.pool)
+        x = rng.integers(1, 2**32, size=(1000, 4), dtype=np.uint32)
+        xg = rt.shard_records(x)
+        part = modulo_partitioner(1)
+        plan = ex.plan(xg, part, num_parts=1)
+        assert plan.num_rounds == 1
+        out, totals, _ = ex.exchange(xg, part, plan, sort_key_words=2)
+        assert int(np.asarray(totals)[0]) == 1000
+        got = np.asarray(out)[:, :1000].T
+        order = np.lexsort((x[:, 1], x[:, 0]))
+        np.testing.assert_array_equal(got[:, :2], x[order][:, :2])
+        # conservation of full records
+        canon = lambda a: a[np.lexsort(tuple(a[:, c] for c in range(4)))]
+        np.testing.assert_array_equal(canon(got), canon(x))
+    finally:
+        rt.stop()
