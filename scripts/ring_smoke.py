@@ -61,7 +61,10 @@ def main() -> int:
                 pltpu.SemaphoreType.DMA,
             ],
             compiler_params=pltpu.CompilerParams(
-                has_side_effects=True, collective_id=7),
+                has_side_effects=True,
+                # collective_id is only legal with the barrier-semaphore
+                # handshake, which needs >1 device
+                collective_id=(7 if n > 1 else None)),
             interpret=False,
         )(slots)
 
@@ -72,7 +75,10 @@ def main() -> int:
                               concat_axis=0, tiled=True)
 
     rng = np.random.default_rng(0)
-    x = rng.integers(0, 2**32, size=(n * n, per, w), dtype=np.uint32)
+    # [P, W, per]: keep the long axis minor (a 4-wide minor dim has no
+    # Mosaic layout; the real exchange's slots are [mesh, ppd, W, C] for
+    # the same reason)
+    x = rng.integers(0, 2**32, size=(n * n, w, per), dtype=np.uint32)
     xg = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("shuffle")))
 
     fns = {}

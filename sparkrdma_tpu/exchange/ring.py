@@ -23,8 +23,17 @@ chunk crosses the fabric once; the ICI torus routes it). A barrier
 semaphore handshake precedes the sends so no device writes into a peer
 that has not yet entered the kernel (the rdma_cm connect/accept analogue).
 
-Runs compiled on TPU and in interpret mode on CPU meshes (the test
-backend the reference never had).
+Coverage status (round 3, measured): parity/golden tests run the kernel
+in interpret mode on the 8-device CPU mesh (the HLO interpreter cannot
+lower collective semaphores, so the barrier handshake is interpret-
+skipped by necessity, not choice); ``scripts/ring_smoke.py`` compiles
+and executes the kernel on real TPU hardware — on the single attached
+chip that exercises the Mosaic-lowered local-DMA + semaphore path
+(byte-identical to ``lax.all_to_all``), while the remote-DMA sends and
+barrier handshake compile but need a multi-chip pod to execute. The
+docstring's promised scheduling advantages (priority tiers, in-kernel
+compute overlap) therefore remain UNPROVEN on this hardware; until a
+pod run shows a schedule XLA won't emit, prefer ``transport="xla"``.
 """
 
 from __future__ import annotations
