@@ -1,0 +1,184 @@
+"""Spark-verb convenience layer over the ShuffleManager SPI.
+
+A SparkRDMA user never calls the ShuffleManager SPI directly — Spark
+does, underneath ``rdd.repartition / sortByKey / reduceByKey / join``
+(SURVEY.md §1: "user jobs: rdd.sortByKey(), Spark SQL joins ... via
+spark.shuffle.manager conf"). This module provides those verbs so a user
+of the reference finds the workflow they actually type, built entirely on
+the public SPI (register_shuffle / get_writer / get_reader /
+unregister_shuffle).
+
+A :class:`Dataset` wraps a device-resident columnar record batch
+``uint32[W, N]`` (see ``MeshRuntime.shard_records``). Every shuffle verb
+runs one planned exchange and returns a NEW Dataset holding the exchange
+output (padded per device; ``totals`` tracks valid counts). Outputs are
+detached from the pool's recycling (copied) so Datasets are ordinary
+value-semantics handles — the convenience layer trades one buffer copy
+for not exposing the consume-before-reuse contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import (hash_partitioner,
+                                                 range_partitioner)
+from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+
+#: Dataset-layer shuffle ids live in their own range to stay clear of
+#: explicitly-managed shuffles on the same manager.
+_ID_COUNTER = itertools.count(1 << 20)
+
+
+class Dataset:
+    """A distributed batch of fixed-width records with Spark-ish verbs."""
+
+    def __init__(self, manager: ShuffleManager, records: jax.Array,
+                 totals: Optional[jax.Array] = None):
+        self.manager = manager
+        self.records = records          # columnar [W, mesh * cap]
+        mesh = manager.runtime.num_partitions
+        if totals is None:
+            per = records.shape[1] // mesh
+            totals = jnp.full((mesh,), per, jnp.int32)
+        self.totals = totals
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_host_rows(cls, manager: ShuffleManager,
+                       rows: np.ndarray) -> "Dataset":
+        """Rows ``[N, W]`` -> device Dataset (N divisible by mesh)."""
+        return cls(manager, manager.runtime.shard_records(rows))
+
+    def to_host_rows(self) -> np.ndarray:
+        """Valid records only, concatenated in device order."""
+        mesh = self.manager.runtime.num_partitions
+        cap = self.records.shape[1] // mesh
+        cols = np.asarray(self.records)
+        tot = np.asarray(self.totals)
+        return np.concatenate(
+            [cols[:, d * cap:d * cap + int(tot[d])].T for d in range(mesh)]
+        )
+
+    @property
+    def count(self) -> int:
+        return int(np.asarray(self.totals).sum())
+
+    # ------------------------------------------------------------------
+    def _exchange(self, partitioner: Callable, num_parts: int,
+                  key_ordering: bool = False,
+                  aggregator: Optional[str] = None,
+                  float_payload: bool = False) -> "Dataset":
+        m = self.manager
+        sid = next(_ID_COUNTER)
+        handle = m.register_shuffle(sid, num_parts, partitioner)
+        try:
+            m.get_writer(handle).write(self._dense_records()).stop(True)
+            out, totals = m.get_reader(
+                handle, key_ordering=key_ordering, aggregator=aggregator,
+                float_payload=float_payload).read()
+            # detach from the pool before unregister releases the buffer
+            return Dataset(m, jnp.array(out), jnp.array(totals))
+        finally:
+            m.unregister_shuffle(sid)
+
+    def _dense_records(self) -> jax.Array:
+        """Writer input: the exchange counts every column, so padded
+        Datasets re-route padding to a null key first.
+
+        Padding rows are all-zero; real keys produced by this layer are
+        unconstrained, so padding is made inert by the partitioners
+        (key 0 hashes/ranges somewhere harmless) and dropped on the next
+        ``to_host_rows`` via totals... except totals from a previous
+        exchange already exclude padding — so when the Dataset is
+        exactly dense (fresh from host) this is the identity, and when
+        padded we compact on host (convenience layer: clarity over one
+        device pass).
+        """
+        mesh = self.manager.runtime.num_partitions
+        cap = self.records.shape[1] // mesh
+        tot = np.asarray(self.totals)
+        if int(tot.sum()) == self.records.shape[1]:
+            return self.records
+        rows = self.to_host_rows()
+        pad = (-len(rows)) % mesh
+        if pad:
+            rows = np.concatenate(
+                [rows, np.zeros((pad, rows.shape[1]), rows.dtype)])
+        return self.manager.runtime.shard_records(rows)
+
+    # ------------------------------------------------------------------
+    # the Spark verbs
+    # ------------------------------------------------------------------
+    def repartition(self, num_parts: Optional[int] = None) -> "Dataset":
+        """Hash-repartition across the mesh (rdd.repartition)."""
+        m = self.manager
+        num_parts = num_parts or m.runtime.num_partitions
+        part = hash_partitioner(num_parts, m.conf.key_words)
+        return self._exchange(part, num_parts)
+
+    def sort_by_key(self, samples_per_device: int = 256) -> "Dataset":
+        """Globally sort by the key words (rdd.sortByKey): sample ->
+        range partition -> exchange -> fused per-device sort."""
+        m = self.manager
+        rt = m.runtime
+        records = self._dense_records()
+        sampler = make_sampler(rt.mesh, rt.axis_name, m.conf.key_words,
+                               samples_per_device)
+        samples = np.asarray(jax.device_get(sampler(records)))
+        splitters = compute_splitters(samples, rt.num_partitions)
+        part = range_partitioner(splitters, m.conf.key_words)
+        ds = Dataset(m, records)
+        return ds._exchange(part, rt.num_partitions, key_ordering=True)
+
+    def reduce_by_key(self, op: str = "sum",
+                      float_payload: bool = False) -> "Dataset":
+        """Combine payloads per unique key (rdd.reduceByKey): hash
+        co-partition + the reader's fused aggregator."""
+        m = self.manager
+        num_parts = m.runtime.num_partitions
+        part = hash_partitioner(num_parts, m.conf.key_words)
+        return self._exchange(part, num_parts, aggregator=op,
+                              float_payload=float_payload)
+
+    def join_count(self, other: "Dataset") -> Tuple[int, float]:
+        """Inner-join cardinality + sum of payload products against
+        ``other`` on the low key word (the TPC-DS-style aggregate join;
+        rdd.join followed by the standard reductions)."""
+        from sparkrdma_tpu.workloads.join import (_local_join)  # noqa
+        import weakref
+
+        from jax.sharding import PartitionSpec as P
+
+        from sparkrdma_tpu.utils.compat import shard_map
+
+        m = self.manager
+        rt = m.runtime
+        num_parts = rt.num_partitions
+        part = hash_partitioner(num_parts, m.conf.key_words)
+        a = self._exchange(part, num_parts)
+        b = other._exchange(part, num_parts)
+        ca = a.records.shape[1] // num_parts
+        cb = b.records.shape[1] // num_parts
+        ax = rt.axis_name
+
+        def local(ra, ta, rb, tb):
+            c, s = _local_join(ra, ta, rb, tb, ca, cb)
+            return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
+
+        fn = jax.jit(shard_map(
+            local, mesh=rt.mesh,
+            in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+            out_specs=(P(ax), P(ax)),
+        ))
+        cnt, sm = fn(a.records, a.totals, b.records, b.totals)
+        return int(np.asarray(cnt)[0]), float(np.asarray(sm)[0])
+
+
+__all__ = ["Dataset"]

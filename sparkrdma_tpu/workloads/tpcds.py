@@ -217,4 +217,129 @@ def run_q64_shape(
     )
 
 
-__all__ = ["run_q64_shape", "QueryResult"]
+@dataclasses.dataclass
+class Q95Result:
+    sales_rows: int
+    qualifying: int
+    net_sum: float
+    shuffle_s: float
+    verified: Optional[bool] = None
+
+
+def run_q95_shape(
+    manager: ShuffleManager,
+    sales_rows_per_device: int = 256,
+    return_rows_per_device: int = 64,
+    n_orders: int = 512,
+    n_warehouses: int = 8,
+    return_order_offset: int = 0,
+    seed: int = 0,
+    shuffle_ids: Tuple[int, int] = (45, 46),
+    verify: bool = True,
+) -> Q95Result:
+    """TPC-DS q95 shape: a self-SEMI-join plus an ANTI-join, both
+    requiring co-partitioning, then a global aggregate.
+
+    q95 counts web sales whose order ALSO ships from a different
+    warehouse (EXISTS over the same table) and was never returned
+    (NOT EXISTS against web_returns). Here: sales(order, warehouse,
+    net) and returns(order) are hash-co-partitioned by order key (two
+    exchanges through the public SPI); the per-device leg sorts sales by
+    (order, warehouse) so EXISTS-different-warehouse reduces to "first
+    and last warehouse in my order's run differ" (distinct>=2 iff
+    min!=max on a sorted run) and NOT-EXISTS is one searchsorted probe
+    into the sorted returns; `psum` folds count and net across the mesh.
+    """
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    rng = np.random.default_rng(seed)
+    ns = mesh * sales_rows_per_device
+    nr = mesh * return_rows_per_device
+
+    sales = np.zeros((ns, 4), dtype=np.uint32)
+    sales[:, 1] = rng.integers(1, n_orders + 1, size=ns)      # order key
+    sales[:, 2] = rng.integers(0, n_warehouses, size=ns)      # warehouse
+    sales[:, 3] = rng.integers(1, 1000, size=ns)              # net paid
+    returns = np.zeros((nr, 4), dtype=np.uint32)
+    # return_order_offset shifts return keys out of the sales order
+    # space (offset >= n_orders = the provably-zero-returns path)
+    returns[:, 1] = (rng.integers(1, n_orders + 1, size=nr)
+                     + return_order_offset)
+
+    part = hash_partitioner(mesh, manager.conf.key_words)
+    t0 = time.perf_counter()
+
+    outs = []
+    for sid, table in zip(shuffle_ids, (sales, returns)):
+        handle = manager.register_shuffle(sid, mesh, part)
+        writer = manager.get_writer(handle).write(rt.shard_records(table))
+        writer.stop(True)
+        out, totals = manager.get_reader(handle).read(record_stats=False)
+        outs.append((out, totals, writer.plan.out_capacity))
+
+    (so, st, sc), (ro, rtot, rc) = outs
+    ax = rt.axis_name
+
+    def local(sales_c, s_tot, ret_c, r_tot):
+        ns_c, nr_c = s_tot[0], r_tot[0]
+        sv = jnp.arange(sc) < ns_c
+        rv = jnp.arange(rc) < nr_c
+        key = jnp.where(sv, sales_c[1], jnp.uint32(0xFFFFFFFF))
+        # sort by (order, warehouse): run min/max warehouse are the ends
+        sk, swh, snet, svv = jax.lax.sort(
+            (key, sales_c[2], sales_c[3], sv), num_keys=2, is_stable=True)
+        lo = jnp.searchsorted(sk, sk, side="left")
+        hi = jnp.searchsorted(sk, sk, side="right")
+        wmin = jnp.take(swh, lo)
+        wmax = jnp.take(swh, jnp.maximum(hi - 1, 0))
+        exists_other = (wmin != wmax) & svv
+        rkey = jnp.where(rv, ret_c[1], jnp.uint32(0xFFFFFFFF))
+        rsorted = jnp.sort(rkey)
+        ridx = jnp.minimum(jnp.searchsorted(rsorted, sk), rc - 1)
+        returned = (jnp.take(rsorted, ridx) == sk) & svv
+        qual = exists_other & ~returned
+        count = jnp.sum(qual).astype(jnp.int32)
+        net = jnp.sum(jnp.where(qual, snet, 0).astype(jnp.float32))
+        return (jax.lax.psum(count, ax)[None],
+                jax.lax.psum(net, ax)[None])
+
+    barrier(so)
+    shuffle_s = time.perf_counter() - t0   # exchanges only, not compile
+
+    cache = _lookup_cache.setdefault(manager, {})
+    ckey = ("q95", sc, rc)
+    fn = cache.get(ckey)
+    if fn is None:
+        fn = jax.jit(shard_map(
+            local, mesh=rt.mesh,
+            in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+            out_specs=(P(ax), P(ax)),
+        ))
+        cache[ckey] = fn
+    cnt, net = fn(so, st, ro, rtot)
+    count = int(np.asarray(cnt)[0])
+    net_sum = float(np.asarray(net)[0])
+    for sid in shuffle_ids:
+        manager.unregister_shuffle(sid)
+
+    verified = None
+    if verify:
+        wh_by_order: Dict[int, set] = {}
+        for i in range(ns):
+            wh_by_order.setdefault(int(sales[i, 1]), set()).add(
+                int(sales[i, 2]))
+        returned_orders = set(int(returns[i, 1]) for i in range(nr))
+        ref_cnt, ref_net = 0, 0.0
+        for i in range(ns):
+            o = int(sales[i, 1])
+            if len(wh_by_order[o]) >= 2 and o not in returned_orders:
+                ref_cnt += 1
+                ref_net += float(sales[i, 3])
+        verified = (count == ref_cnt
+                    and abs(net_sum - ref_net) <= 1e-6 * max(1.0, ref_net))
+
+    return Q95Result(sales_rows=ns, qualifying=count, net_sum=net_sum,
+                     shuffle_s=shuffle_s, verified=verified)
+
+
+__all__ = ["run_q64_shape", "run_q95_shape", "QueryResult", "Q95Result"]

@@ -1,0 +1,83 @@
+"""Spark-verb Dataset layer vs numpy references (the workflow a user of
+the reference actually types: repartition / sortByKey / reduceByKey /
+join — SURVEY.md §1 user-jobs row)."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import ShuffleConf
+from sparkrdma_tpu.api.dataset import Dataset
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+
+@pytest.fixture(scope="module")
+def manager():
+    m = ShuffleManager(conf=ShuffleConf(slot_records=256))
+    yield m
+    m.stop()
+
+
+def canon(a):
+    return a[np.lexsort(tuple(a[:, c] for c in range(a.shape[1] - 1, -1,
+                                                     -1)))]
+
+
+def test_repartition_preserves_multiset(manager, rng):
+    x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
+    ds = Dataset.from_host_rows(manager, x).repartition()
+    assert ds.count == x.shape[0]
+    np.testing.assert_array_equal(canon(ds.to_host_rows()), canon(x))
+
+
+def test_sort_by_key_globally_sorted(manager, rng):
+    x = rng.integers(1, 2**32, size=(8 * 128, 4), dtype=np.uint32)
+    ds = Dataset.from_host_rows(manager, x).sort_by_key()
+    got = ds.to_host_rows()
+    assert got.shape[0] == x.shape[0]
+    keys = got[:, 0].astype(np.uint64) << np.uint64(32) | got[:, 1]
+    assert np.all(keys[1:] >= keys[:-1]), "not globally sorted"
+    np.testing.assert_array_equal(canon(got), canon(x))
+
+
+def test_reduce_by_key_matches_numpy(manager, rng):
+    n = 8 * 64
+    x = np.zeros((n, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(1, 20, size=n)       # small key space
+    x[:, 2] = rng.integers(1, 100, size=n)
+    ds = Dataset.from_host_rows(manager, x).reduce_by_key("sum")
+    got = ds.to_host_rows()
+    ref = {}
+    for i in range(n):
+        k = (0, int(x[i, 1]))
+        ref[k] = ref.get(k, 0) + int(x[i, 2])
+    got_map = {(int(r[0]), int(r[1])): int(r[2]) for r in got}
+    assert got_map == ref
+
+
+def test_chained_verbs(manager, rng):
+    """repartition -> sortByKey chains across exchanges (padded Dataset
+    re-densification path)."""
+    x = rng.integers(1, 2**32, size=(8 * 48, 4), dtype=np.uint32)
+    ds = Dataset.from_host_rows(manager, x).repartition(16).sort_by_key()
+    np.testing.assert_array_equal(canon(ds.to_host_rows()), canon(x))
+
+
+def test_join_count_matches_numpy(manager, rng):
+    na, nb = 8 * 32, 8 * 24
+    xa = np.zeros((na, 4), dtype=np.uint32)
+    xb = np.zeros((nb, 4), dtype=np.uint32)
+    xa[:, 1] = rng.integers(1, 16, size=na)
+    xb[:, 1] = rng.integers(1, 16, size=nb)
+    xa[:, 2] = rng.integers(1, 50, size=na)
+    xb[:, 2] = rng.integers(1, 50, size=nb)
+    da = Dataset.from_host_rows(manager, xa)
+    db = Dataset.from_host_rows(manager, xb)
+    cnt, sm = da.join_count(db)
+    ref_cnt = 0
+    ref_sum = 0.0
+    for i in range(na):
+        match = xb[xb[:, 1] == xa[i, 1]]
+        ref_cnt += len(match)
+        ref_sum += float(xa[i, 2]) * match[:, 2].astype(np.float64).sum()
+    assert cnt == ref_cnt
+    assert abs(sm - ref_sum) <= 1e-6 * max(1.0, abs(ref_sum))

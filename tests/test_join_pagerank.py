@@ -85,3 +85,41 @@ class TestQ64Shape:
                              region_cutoff=8, shuffle_ids=(55, 56, 57,
                                                            58, 59))
         assert full.verified and full.total_value > 0
+
+
+class TestQ95Shape:
+    """q95 shape (BASELINE config 3): EXISTS-different-warehouse
+    self-semi-join + NOT-EXISTS anti-join + global aggregate, verified
+    vs a numpy reference of the full query."""
+
+    def test_q95_matches_numpy(self, manager):
+        from sparkrdma_tpu.workloads.tpcds import run_q95_shape
+
+        res = run_q95_shape(manager, sales_rows_per_device=128,
+                            return_rows_per_device=32)
+        assert res.verified, "q95 aggregate differs from numpy"
+        assert 0 < res.qualifying < res.sales_rows
+
+    def test_q95_no_returns_all_multiwarehouse(self, manager):
+        """Degenerate selectivities: return keys shifted out of the
+        order space (provably zero anti-join hits) + a tiny order space
+        (every order multi-warehouse) -> every sales row qualifies."""
+        from sparkrdma_tpu.workloads.tpcds import run_q95_shape
+
+        res = run_q95_shape(manager, sales_rows_per_device=64,
+                            return_rows_per_device=1, n_orders=4,
+                            return_order_offset=1000,
+                            shuffle_ids=(47, 48))
+        assert res.verified
+        assert res.qualifying == res.sales_rows
+
+    def test_q95_all_returned_none_qualify(self, manager):
+        """The opposite degenerate: a tiny order space with plenty of
+        returns anti-joins every order away."""
+        from sparkrdma_tpu.workloads.tpcds import run_q95_shape
+
+        res = run_q95_shape(manager, sales_rows_per_device=64,
+                            return_rows_per_device=32, n_orders=4,
+                            shuffle_ids=(49, 50))
+        assert res.verified
+        assert res.qualifying == 0
