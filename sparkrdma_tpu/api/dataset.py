@@ -15,12 +15,20 @@ output (padded per device; ``totals`` tracks valid counts). Outputs are
 detached from the pool's recycling (copied) so Datasets are ordinary
 value-semantics handles — the convenience layer trades one buffer copy
 for not exposing the consume-before-reuse contract.
+
+RESERVED NULL KEY: the all-ones key (every key word 0xFFFFFFFF) is
+reserved by this layer. When a chained verb needs to re-densify a padded
+Dataset whose valid count is not divisible by the mesh size, filler rows
+carry the null key; ``to_host_rows``/``count`` filter them out, and the
+join masks them from matching. User data must not use the all-ones key
+(Spark's own NULL-key handling makes the same kind of reservation).
 """
 
 from __future__ import annotations
 
 import itertools
-from typing import Callable, Optional, Tuple
+import weakref
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +42,73 @@ from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
 #: Dataset-layer shuffle ids live in their own range to stay clear of
 #: explicitly-managed shuffles on the same manager.
 _ID_COUNTER = itertools.count(1 << 20)
+
+_NULL = np.uint32(0xFFFFFFFF)
+
+
+def _low_word_hash(num_parts: int) -> Callable:
+    """Hash-partition on the LOW key word only — the join key. The
+    full-key hash_partitioner would scatter rows that agree on the low
+    word but differ in the high word to different devices, silently
+    dropping their matches from a low-word join."""
+
+    def part(records):
+        h = records[1] * jnp.uint32(2654435761)
+        return (h % jnp.uint32(num_parts)).astype(jnp.int32)
+
+    part.cache_key = ("lowhash", num_parts)
+    return part
+
+
+#: Compiled join cache per manager (weak) keyed by capacities — a fresh
+#: jit closure per call would retrace+recompile every join (the same
+#: rationale as workloads/join.py's _join_cache).
+_join_programs: "weakref.WeakKeyDictionary[ShuffleManager, Dict[Tuple, Callable]]" \
+    = weakref.WeakKeyDictionary()
+
+
+def _join_program(manager: ShuffleManager, ca: int, cb: int) -> Callable:
+    cache = _join_programs.setdefault(manager, {})
+    fn = cache.get((ca, cb))
+    if fn is not None:
+        return fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from sparkrdma_tpu.utils.compat import shard_map
+    from sparkrdma_tpu.workloads.join import _local_join
+
+    rt = manager.runtime
+    ax = rt.axis_name
+    null = jnp.uint32(_NULL)
+
+    def local(ra, ta, rb, tb):
+        # mask reserved null-key filler so it can never join with the
+        # other side's filler (both sides' pads share the null low word)
+        va = (jnp.arange(ca) < ta[0]) & (ra[1] != null)
+        vb = (jnp.arange(cb) < tb[0]) & (rb[1] != null)
+        ra = jnp.where(va[None], ra, jnp.uint32(0))
+        rb = jnp.where(vb[None], rb, jnp.uint32(0))
+        ta2 = jnp.sum(va).astype(jnp.int32)[None]
+        tb2 = jnp.sum(vb).astype(jnp.int32)[None]
+        # re-compact validity as a prefix for _local_join's contract:
+        # sort valid-first (stable) on each side
+        sa = jax.lax.sort(((~va).astype(jnp.uint8),) + tuple(
+            ra[i] for i in range(ra.shape[0])), num_keys=1, is_stable=True)
+        sb = jax.lax.sort(((~vb).astype(jnp.uint8),) + tuple(
+            rb[i] for i in range(rb.shape[0])), num_keys=1, is_stable=True)
+        ra = jnp.stack(sa[1:])
+        rb = jnp.stack(sb[1:])
+        c, s = _local_join(ra, ta2, rb, tb2, ca, cb)
+        return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
+
+    fn = jax.jit(shard_map(
+        local, mesh=rt.mesh,
+        in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+        out_specs=(P(ax), P(ax)),
+    ))
+    cache[(ca, cb)] = fn
+    return fn
 
 
 class Dataset:
@@ -57,18 +132,24 @@ class Dataset:
         return cls(manager, manager.runtime.shard_records(rows))
 
     def to_host_rows(self) -> np.ndarray:
-        """Valid records only, concatenated in device order."""
+        """Valid records only, concatenated in device order (reserved
+        null-key filler rows filtered out)."""
         mesh = self.manager.runtime.num_partitions
         cap = self.records.shape[1] // mesh
         cols = np.asarray(self.records)
         tot = np.asarray(self.totals)
-        return np.concatenate(
+        rows = np.concatenate(
             [cols[:, d * cap:d * cap + int(tot[d])].T for d in range(mesh)]
         )
+        kw = self.manager.conf.key_words
+        null = (rows[:, :kw] == _NULL).all(axis=1)
+        return rows[~null]
 
     @property
     def count(self) -> int:
-        return int(np.asarray(self.totals).sum())
+        """Valid, non-filler record count (host trip when the Dataset
+        carries null-key filler from a re-densification)."""
+        return self.to_host_rows().shape[0]
 
     # ------------------------------------------------------------------
     def _exchange(self, partitioner: Callable, num_parts: int,
@@ -89,28 +170,23 @@ class Dataset:
             m.unregister_shuffle(sid)
 
     def _dense_records(self) -> jax.Array:
-        """Writer input: the exchange counts every column, so padded
-        Datasets re-route padding to a null key first.
-
-        Padding rows are all-zero; real keys produced by this layer are
-        unconstrained, so padding is made inert by the partitioners
-        (key 0 hashes/ranges somewhere harmless) and dropped on the next
-        ``to_host_rows`` via totals... except totals from a previous
-        exchange already exclude padding — so when the Dataset is
-        exactly dense (fresh from host) this is the identity, and when
-        padded we compact on host (convenience layer: clarity over one
-        device pass).
+        """Writer input: the exchange counts every column, so a padded
+        Dataset is re-densified first (host compaction — convenience
+        layer: clarity over one device pass). When the valid count is
+        not divisible by the mesh, filler rows carry the RESERVED null
+        key so every downstream verb can identify and exclude them
+        (``to_host_rows`` filters; the join masks) — zero-filler would
+        masquerade as real records and inflate counts.
         """
-        mesh = self.manager.runtime.num_partitions
-        cap = self.records.shape[1] // mesh
         tot = np.asarray(self.totals)
         if int(tot.sum()) == self.records.shape[1]:
             return self.records
         rows = self.to_host_rows()
+        mesh = self.manager.runtime.num_partitions
         pad = (-len(rows)) % mesh
         if pad:
             rows = np.concatenate(
-                [rows, np.zeros((pad, rows.shape[1]), rows.dtype)])
+                [rows, np.full((pad, rows.shape[1]), _NULL, rows.dtype)])
         return self.manager.runtime.shard_records(rows)
 
     # ------------------------------------------------------------------
@@ -149,34 +225,19 @@ class Dataset:
 
     def join_count(self, other: "Dataset") -> Tuple[int, float]:
         """Inner-join cardinality + sum of payload products against
-        ``other`` on the low key word (the TPC-DS-style aggregate join;
-        rdd.join followed by the standard reductions)."""
-        from sparkrdma_tpu.workloads.join import (_local_join)  # noqa
-        import weakref
-
-        from jax.sharding import PartitionSpec as P
-
-        from sparkrdma_tpu.utils.compat import shard_map
-
+        ``other`` on the LOW key word (the TPC-DS-style aggregate join;
+        rdd.join followed by the standard reductions). Both sides are
+        co-partitioned on the low word alone — the join key — and the
+        reserved null key never matches."""
         m = self.manager
         rt = m.runtime
         num_parts = rt.num_partitions
-        part = hash_partitioner(num_parts, m.conf.key_words)
+        part = _low_word_hash(num_parts)
         a = self._exchange(part, num_parts)
         b = other._exchange(part, num_parts)
         ca = a.records.shape[1] // num_parts
         cb = b.records.shape[1] // num_parts
-        ax = rt.axis_name
-
-        def local(ra, ta, rb, tb):
-            c, s = _local_join(ra, ta, rb, tb, ca, cb)
-            return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
-
-        fn = jax.jit(shard_map(
-            local, mesh=rt.mesh,
-            in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
-            out_specs=(P(ax), P(ax)),
-        ))
+        fn = _join_program(m, ca, cb)
         cnt, sm = fn(a.records, a.totals, b.records, b.totals)
         return int(np.asarray(cnt)[0]), float(np.asarray(sm)[0])
 

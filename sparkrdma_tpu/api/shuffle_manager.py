@@ -37,6 +37,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from sparkrdma_tpu.config import ShuffleConf
@@ -60,6 +61,22 @@ class ShuffleHandle:
     shuffle_id: int
     num_parts: int
     partitioner: Callable
+
+
+def _partition_window(plan: ShufflePlan, mesh: int,
+                      partition: int) -> Tuple[int, int, int]:
+    """Locate partition ``p`` inside the raw exchange output layout.
+
+    Returns ``(device, start_within_device, length)``. The output
+    stream on device ``d`` is its local partitions in ascending global
+    id, each a contiguous segment of ``sum(counts[:, p])`` records —
+    the single source of truth for this layout math (used by both
+    ``read_partition`` and ``OutputView.partition``).
+    """
+    d, q = partition % mesh, partition // mesh
+    owned = plan.counts.sum(axis=0)
+    start = sum(int(owned[qq * mesh + d]) for qq in range(q))
+    return d, start, int(owned[partition])
 
 
 class ShuffleWriter:
@@ -275,6 +292,33 @@ class ShuffleReader:
         del incoming
         return out, totals
 
+    def read_view(self) -> "OutputView":
+        """Run the exchange and return a REF-COUNTED view over the
+        output — the ``RdmaRegisteredBuffer`` consumer contract: one
+        received buffer sliced into per-partition views with independent
+        lifetimes, returned to the buffer pool on the last release.
+
+        ``view.partition(p)`` gives partition ``p``'s records as a
+        device-array slice without re-running the exchange (each call
+        retains; release each view, then the base, and the buffer pages
+        go back to the :class:`~sparkrdma_tpu.hbm.slot_pool.SlotPool`
+        for a later exchange to donate).
+
+        Per-partition slicing needs the raw (local partition, source)
+        layout, so the view always reads full-range and unsorted
+        regardless of this reader's options (same rule and reason as
+        :meth:`read_partition`).
+        """
+        plan = self._m._recover_writer(self._h).plan
+        if plan is not None and plan.split_factor > 1:
+            # check BEFORE dispatching the (large, skewed) full exchange
+            raise ValueError(
+                "partition views are not supported on a skew-split "
+                "shuffle (records of one partition span sub-partitions)")
+        out, totals = ShuffleReader(self._m, self._h).read()
+        plan = self._m._writers[self._h.shuffle_id].plan
+        return OutputView(self._m, self._h, out, totals, plan)
+
     def read_partition(self, partition: int) -> np.ndarray:
         """Materialize one partition's records on host (debug/small data).
 
@@ -291,26 +335,76 @@ class ShuffleReader:
             # check BEFORE dispatching the (large, skewed) full exchange
             raise ValueError(
                 "read_partition is not supported on a skew-split shuffle")
-        # Segment offsets below assume the unsorted (local partition,
-        # source) layout, so read without key ordering even if this
-        # reader sorts — per-partition slices are cut from the raw layout.
-        out, totals = ShuffleReader(
-            self._m, self._h, self.start_partition, self.end_partition,
-            key_ordering=False,
-        ).read()
+        # Segment offsets assume the raw full-range (local partition,
+        # source) layout, so read full-range and unsorted even if this
+        # reader filters/sorts — slices are cut from the raw layout via
+        # the shared _partition_window math.
+        out, totals = ShuffleReader(self._m, self._h).read()
         mesh = self._m.runtime.num_partitions
-        d, q = partition % mesh, partition // mesh
         plan = self._m._writers[self._h.shuffle_id].plan
         cap = plan.out_capacity
+        d, start, length = _partition_window(plan, mesh, partition)
         dev_cols = np.asarray(out)[:, d * cap:(d + 1) * cap]   # [W, cap]
-        # partition starts after device d's earlier *kept* local partitions
-        owned = plan.counts.sum(axis=0)
-        start = sum(
-            int(owned[qq * mesh + d]) for qq in range(q)
-            if self.start_partition <= qq * mesh + d < self.end_partition
-        )
-        length = int(owned[partition])
         return np.ascontiguousarray(dev_cols[:, start:start + length].T)
+
+
+class OutputView:
+    """Ref-counted exchange output + per-partition slicing — the
+    ``RdmaRegisteredBuffer`` analogue on the consumer side.
+
+    The reference slices one registered fetch buffer into per-block
+    ``ByteBuffer`` views handed to Spark, each holding a reference;
+    the buffer returns to ``RdmaBufferManager`` on the last release.
+    Here the exchange output is DETACHED (copied) from the pool's
+    donation chain into a :class:`~sparkrdma_tpu.hbm.slot_pool.Slot`,
+    ``partition(p)`` retains and slices, and the last ``release``
+    returns the pages to the pool via ``put_shaped`` for a later
+    same-shape exchange to reuse.
+    """
+
+    def __init__(self, manager: "ShuffleManager", handle: ShuffleHandle,
+                 out: jax.Array, totals: jax.Array, plan: ShufflePlan):
+        from sparkrdma_tpu.hbm.slot_pool import Slot
+
+        if plan.split_factor > 1:
+            raise ValueError(
+                "partition views are not supported on a skew-split "
+                "shuffle (records of one partition span sub-partitions)")
+        # detach: the raw output is recycled by the NEXT same-geometry
+        # exchange; a refcounted view must own its pages
+        self._arr = jnp.array(out)
+        self.totals = np.asarray(totals)
+        self._plan = plan
+        self._handle = handle
+        self._m = manager
+        self._pool = manager.runtime.pool
+        self._sharding = manager.runtime.sharding(
+            None, manager.runtime.axis_name)
+        self._slot = Slot(self._arr, self._arr.shape[1],
+                          self._arr.shape[0], self)
+        self._mesh = manager.runtime.num_partitions
+        self._cap = plan.out_capacity
+
+    # Slot's pool-protocol hook: called on the LAST release
+    def _put(self, slot) -> None:
+        if self._pool is not None and not slot.array.is_deleted():
+            self._pool.put_shaped(slot.array, self._sharding)
+
+    def retain(self) -> "OutputView":
+        self._slot.retain()
+        return self
+
+    def release(self) -> None:
+        self._slot.release()
+
+    def partition(self, p: int) -> jax.Array:
+        """Columnar records of partition ``p`` (valid rows only — the
+        reference's per-block view granularity)."""
+        if not 0 <= p < self._handle.num_parts:
+            raise ValueError(f"partition {p} out of range")
+        d, start, length = _partition_window(self._plan, self._mesh, p)
+        start += d * self._cap
+        return lax.slice_in_dim(self._arr, start, start + length, axis=1)
 
 
 class ShuffleManager:
@@ -616,4 +710,5 @@ class ShuffleManager:
         self.stop()
 
 
-__all__ = ["ShuffleManager", "ShuffleHandle", "ShuffleWriter", "ShuffleReader"]
+__all__ = ["ShuffleManager", "ShuffleHandle", "ShuffleWriter",
+           "ShuffleReader", "OutputView"]

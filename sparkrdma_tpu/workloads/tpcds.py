@@ -303,7 +303,7 @@ def run_q95_shape(
         return (jax.lax.psum(count, ax)[None],
                 jax.lax.psum(net, ax)[None])
 
-    barrier(so)
+    barrier(ro)   # ro is dispatched last: syncing it covers BOTH exchanges
     shuffle_s = time.perf_counter() - t0   # exchanges only, not compile
 
     cache = _lookup_cache.setdefault(manager, {})

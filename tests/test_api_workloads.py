@@ -315,3 +315,53 @@ def test_device_verify_catches_dup_drop_collision(manager):
     assert not device_verify_sort(manager, records, out_bad, totals,
                                   key_words=2, out_capacity=n_per), \
         "dup/drop pair with equal word sums must be caught by the hash"
+
+
+class TestOutputView:
+    """read_view: the RdmaRegisteredBuffer consumer contract — one
+    received buffer, per-partition retained views, pool return on the
+    last release."""
+
+    def test_views_match_read_partition(self, manager, rng):
+        part = modulo_partitioner(8)
+        handle = manager.register_shuffle(70, 8, part)
+        try:
+            x = rng.integers(1, 2**32, size=(8 * 32, 4), dtype=np.uint32)
+            manager.get_writer(handle).write(
+                manager.runtime.shard_records(x)).stop(True)
+            view = manager.get_reader(handle).read_view()
+            canon = lambda a: a[np.lexsort(tuple(a[:, c]
+                                                 for c in range(4)))]
+            for p in (0, 3, 7):
+                got = np.asarray(view.retain().partition(p)).T
+                ref = x[np.asarray(part(jnp.asarray(x.T))) == p]
+                np.testing.assert_array_equal(canon(got), canon(ref))
+                view.release()
+            free_before = sum(manager.runtime.pool.free_counts().values())
+            view.release()                   # last ref -> pages to pool
+            free_after = sum(manager.runtime.pool.free_counts().values())
+            assert free_after == free_before + 1
+            with pytest.raises(RuntimeError, match="release"):
+                view.release()               # double release refused
+        finally:
+            manager.unregister_shuffle(70)
+
+    def test_view_survives_next_exchange(self, manager, rng):
+        """A held view must stay valid while later same-geometry
+        exchanges recycle their own buffers (the detach contract)."""
+        part = modulo_partitioner(8)
+        x = rng.integers(1, 2**32, size=(8 * 32, 4), dtype=np.uint32)
+        h1 = manager.register_shuffle(71, 8, part)
+        manager.get_writer(h1).write(
+            manager.runtime.shard_records(x)).stop(True)
+        view = manager.get_reader(h1).read_view()
+        p0 = np.asarray(view.partition(0))
+        # a second same-geometry shuffle churns the pool
+        h2 = manager.register_shuffle(72, 8, part)
+        manager.get_writer(h2).write(
+            manager.runtime.shard_records(x)).stop(True)
+        manager.get_reader(h2).read()
+        np.testing.assert_array_equal(np.asarray(view.partition(0)), p0)
+        view.release()
+        manager.unregister_shuffle(71)
+        manager.unregister_shuffle(72)

@@ -81,3 +81,43 @@ def test_join_count_matches_numpy(manager, rng):
         ref_sum += float(xa[i, 2]) * match[:, 2].astype(np.float64).sum()
     assert cnt == ref_cnt
     assert abs(sm - ref_sum) <= 1e-6 * max(1.0, abs(ref_sum))
+
+
+def test_join_on_low_word_ignores_high_word(manager, rng):
+    """Rows agreeing on the low key word but differing in the high word
+    must still join (regression: full-key co-partitioning scattered
+    them to different devices and silently dropped matches)."""
+    na = 8 * 4
+    xa = np.zeros((na, 4), dtype=np.uint32)
+    xb = np.zeros((na, 4), dtype=np.uint32)
+    xa[:, 0] = rng.integers(0, 2**32, size=na, dtype=np.uint32)  # high
+    xb[:, 0] = rng.integers(0, 2**32, size=na, dtype=np.uint32)  # differs
+    xa[:, 1] = np.arange(na) % 7                                  # low=key
+    xb[:, 1] = np.arange(na) % 7
+    xa[:, 2] = 2
+    xb[:, 2] = 3
+    cnt, sm = (Dataset.from_host_rows(manager, xa)
+               .join_count(Dataset.from_host_rows(manager, xb)))
+    ref_cnt = sum(int((xb[:, 1] == xa[i, 1]).sum()) for i in range(na))
+    assert cnt == ref_cnt
+    assert abs(sm - 6.0 * ref_cnt) < 1e-6
+
+
+def test_chained_verbs_non_divisible_count(manager, rng):
+    """A chained verb after reduce_by_key (count not divisible by the
+    mesh) must not inject phantom zero rows (regression: zero-padding
+    counted as real records)."""
+    n = 8 * 32
+    x = np.zeros((n, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(1, 20, size=n)    # 19 possible keys
+    x[:, 2] = 1
+    ds = Dataset.from_host_rows(manager, x).reduce_by_key("sum")
+    uniq = ds.count
+    assert uniq % 8 != 0, "test needs a non-divisible unique count"
+    ds2 = ds.repartition()
+    assert ds2.count == uniq
+    rows = ds2.to_host_rows()
+    assert not ((rows[:, :2] == 0).all(axis=1) & (rows[:, 2:] == 0)
+                .all(axis=1)).any(), "phantom zero rows leaked"
+    ds3 = ds.sort_by_key()
+    assert ds3.count == uniq
