@@ -19,7 +19,7 @@ the host-side permutation proof that tests/ run at test scale.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: BENCH_RECORDS_PER_DEVICE (default 32M ~= 512MB/chip),
-BENCH_REPEATS (default 8), BENCH_RECORD_WORDS (default 4 = 16B records:
+BENCH_REPEATS (default 16), BENCH_RECORD_WORDS (default 4 = 16B records:
 2-word key + 2-word payload).
 
 Measured context (v5e, scripts/profile5-7 + /tmp sweeps, round 3): the
@@ -43,7 +43,7 @@ def main() -> int:
     # over larger batches (measured 2.27 vs 2.10 GB/s at 256MB)
     records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
                                             32 * 1024 * 1024))
-    repeats = int(os.environ.get("BENCH_REPEATS", 8))
+    repeats = int(os.environ.get("BENCH_REPEATS", 16))
     record_words = int(os.environ.get("BENCH_RECORD_WORDS", 4))
     import jax
 
