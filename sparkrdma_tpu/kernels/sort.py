@@ -112,8 +112,9 @@ def merge_sorted_runs(
     ``runs: uint32[S, C, W]`` (each run sorted on its valid prefix),
     ``run_counts: int32[S]``. Returns ``(merged: [S*C, W], total: int32)``
     with padding at the tail. XLA has no efficient k-way merge primitive, so
-    this flattens and re-sorts — O(n log n) but fully parallel on the VPU;
-    a Pallas true-merge is the planned upgrade (SURVEY.md §7 step 8).
+    this flattens and re-sorts — O(n log n) but fully parallel on the VPU.
+    The Pallas true-merge exists (``kernels/merge_sort.py``) but measured
+    slower than ``lax.sort`` on v5e — see its MEASURED STATUS note.
     """
     s, c, w = runs.shape
     flat = runs.reshape(s * c, w)
