@@ -60,6 +60,9 @@ def main() -> int:
                        max_rounds=64,
                        max_slot_records=max(1 << 22, 2 * slot),
                        val_words=record_words - 2,
+                       # stable geometry across repeats: tight classes
+                       # beat pow2 padding (matters on >1-chip meshes)
+                       geometry_classes="fine",
                        collect_shuffle_read_stats=False)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:

@@ -93,12 +93,24 @@ class ShuffleConf:
     #: host-group count for the hierarchical transport; 0 = auto from the
     #: mesh's process set (devices per host = mesh size / processes)
     hierarchy_hosts: int = 0
+    #: geometry size-class policy: "pow2" (default — few distinct
+    #: compiled geometries, up to 2x slot padding) or "fine" (top-4-bit
+    #: classes, <=6.25% padding, ~16x more potential geometries).
+    #: Use "fine" for stable-geometry workloads (a bench or production
+    #: job repeating one shuffle shape) where padding costs real passes;
+    #: keep "pow2" when shuffle sizes vary call to call, or every
+    #: slightly-different size recompiles its own program. Interaction:
+    #: fine classes rarely produce the power-of-two out_capacity the
+    #: opt-in fast_sort requires, so fast_sort usually falls back to
+    #: lax.sort under "fine".
+    geometry_classes: str = "pow2"
 
     # --- reduce-side sort ---
     #: use the Pallas merge-path sort for fused key-ordering when the
     #: geometry allows (power-of-two output >= 2 runs). It orders by the
     #: FULL record (key words first, payload words break ties) and is
-    #: not stable. Default OFF: measured on v5e at 16M x 16B records the
+    #: not stable (and requires a power-of-two output capacity — see
+    #: geometry_classes). Default OFF: measured on v5e at 16M x 16B records the
     #: kernel's in-VMEM merge network (~40ms/stage) loses to lax.sort's
     #: own fused stages (~6.6ms/doubling; scripts/profile7.py) — XLA's
     #: sort is already near the bitonic bandwidth floor on this
@@ -138,6 +150,9 @@ class ShuffleConf:
                 f"lane-width tile minimum), got {self.fast_sort_run}")
         if self.hierarchy_hosts < 0:
             raise ValueError("hierarchy_hosts must be >= 0")
+        if self.geometry_classes not in ("pow2", "fine"):
+            raise ValueError(
+                f"unknown geometry_classes {self.geometry_classes!r}")
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property
@@ -170,4 +185,26 @@ def size_class(n_records: int) -> int:
     return 1 << (n_records - 1).bit_length()
 
 
-__all__ = ["ShuffleConf", "size_class", "DEFAULT_KEY_WORDS", "DEFAULT_VAL_WORDS"]
+def size_class_fine(n_records: int, bits: int = 4) -> int:
+    """Round up keeping the top ``bits`` bits — eighth/sixteenth-octave
+    size classes for EXCHANGE GEOMETRY (slot capacity, out capacity).
+
+    Power-of-two classes waste up to 2x: a worst (src,dst) pair landing
+    just above a boundary doubles every slot, and every downstream pass
+    pays the inflation (measured ~30% of the multi-partition map-side
+    cost). Keeping 4 top bits caps padding at ~6.7% while the class
+    count stays bounded (~16 per octave), so the compiled-program cache
+    still converges. Padding is < 1/2^bits = 6.25%; counts up to
+    ``2^(bits+1) - 1`` (31) stay exact; large classes are automatically
+    multiples of 128 (lane alignment) once ``n >= 2^(bits+8)``. Buffer
+    POOL bucketing keeps the coarse pow2 classes (reuse across nearby
+    sizes matters more there).
+    """
+    if n_records <= 0:
+        raise ValueError("n_records must be positive")
+    shift = max(0, n_records.bit_length() - 1 - bits)
+    return ((n_records + (1 << shift) - 1) >> shift) << shift
+
+
+__all__ = ["ShuffleConf", "size_class", "size_class_fine",
+           "DEFAULT_KEY_WORDS", "DEFAULT_VAL_WORDS"]

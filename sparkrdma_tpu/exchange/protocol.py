@@ -66,7 +66,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.config import ShuffleConf, size_class
+from sparkrdma_tpu.config import (ShuffleConf, size_class,
+                                  size_class_fine)
 from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
                                              compact_segments,
                                              fill_round_slots)
@@ -241,6 +242,9 @@ class ShuffleExchange:
                 f"{self.mesh_size}"
             )
 
+        classer = (size_class_fine
+                   if self.conf.geometry_classes == "fine" else size_class)
+
         def measure(part_fn, parts):
             key = (parts, getattr(part_fn, "cache_key", id(part_fn)))
             fn = self._count_cache.get(key)
@@ -259,7 +263,7 @@ class ShuffleExchange:
                 # while skew streams in slot_records-sized rounds.
                 # Power-of-two classes bound the number of compiled
                 # geometries (same rule as the buffer pools).
-                cap = min(size_class(max(1, per_pair_max)),
+                cap = min(classer(max(1, per_pair_max)),
                           self.conf.slot_records)
             return counts, cap, max(1, math.ceil(per_pair_max / cap))
 
@@ -288,7 +292,7 @@ class ShuffleExchange:
         per_device_in = np.array(
             [owned[d::self.mesh_size].sum() for d in range(self.mesh_size)]
         )
-        out_capacity = size_class(max(1, int(per_device_in.max())))
+        out_capacity = classer(max(1, int(per_device_in.max())))
         return ShufflePlan(
             counts=counts,
             num_rounds=num_rounds,

@@ -41,3 +41,55 @@ def test_invalid_conf_rejected():
 def test_replace():
     conf = ShuffleConf().replace(slot_records=128)
     assert conf.slot_records == 128
+
+
+def test_size_class_fine():
+    from sparkrdma_tpu.config import size_class_fine
+
+    assert size_class_fine(1) == 1
+    assert size_class_fine(31) == 31          # small: exact
+    assert size_class_fine(33) == 34          # shift=1 -> next even
+    assert size_class_fine(1000) % 32 == 0    # 2^(10-1-4)-multiple
+    assert size_class_fine(1 << 20) == 1 << 20  # pow2 fixed point
+    for n in ((1 << 21) + 1, (1 << 21) - 1, 3_000_000, 12_345_678):
+        fine = size_class_fine(n)
+        assert n <= fine <= int(n * 1.0626), (n, fine)  # <=6.25% padding
+    # large classes are lane-aligned
+    assert size_class_fine((1 << 22) + 12345) % 128 == 0
+    with pytest.raises(ValueError):
+        size_class_fine(0)
+
+
+def test_geometry_classes_policy():
+    """fine classing is opt-in; both policies deliver identical bytes,
+    fine pads the slot tighter."""
+    import numpy as np
+
+    from sparkrdma_tpu import MeshRuntime
+    from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+    from sparkrdma_tpu.exchange.protocol import ShuffleExchange
+
+    outs = {}
+    for policy in ("pow2", "fine"):
+        conf = ShuffleConf(slot_records=1 << 12, geometry_classes=policy)
+        rt = MeshRuntime(conf)
+        try:
+            ex = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+            x = np.random.default_rng(5).integers(
+                1, 2**32, size=(8 * 65, 4), dtype=np.uint32)
+            out, totals, plan = ex.shuffle(
+                rt.shard_records(x), modulo_partitioner(8), 8)
+            # strip per-device padding before comparing across policies
+            cap = plan.out_capacity
+            rows = []
+            tot = np.asarray(totals)
+            o = np.asarray(out)
+            for d in range(8):
+                rows.append(o[:, d * cap:d * cap + int(tot[d])].T)
+            outs[policy] = (np.concatenate(rows), plan.capacity)
+        finally:
+            rt.stop()
+    np.testing.assert_array_equal(outs["pow2"][0], outs["fine"][0])
+    assert outs["fine"][1] <= outs["pow2"][1]
+    with pytest.raises(ValueError, match="geometry_classes"):
+        ShuffleConf(geometry_classes="nope")
