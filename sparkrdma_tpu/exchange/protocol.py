@@ -70,7 +70,8 @@ from sparkrdma_tpu.config import (ShuffleConf, size_class,
                                   size_class_fine)
 from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
                                              compact_segments,
-                                             fill_round_slots)
+                                             fill_round_slots,
+                                             histogram_pids)
 
 from sparkrdma_tpu.utils.compat import shard_map
 
@@ -152,7 +153,7 @@ def _make_count_fn(mesh: Mesh, axis_name: str, num_parts: int,
 
     def local_counts(records):
         pids = partitioner(records).astype(jnp.int32)
-        counts = jnp.bincount(pids, length=num_parts).astype(jnp.int32)
+        counts = histogram_pids(pids, num_parts)   # scatter-free
         # all_gather -> replicated [mesh, P] so EVERY process can read the
         # table locally (multi-host: a sharded output would leave other
         # processes' rows non-addressable). This is the one-sided
@@ -229,7 +230,7 @@ class ShuffleExchange:
     ) -> ShufflePlan:
         """Compute the global counts matrix and derive static geometry.
 
-        One compiled step (bincount + implicit all-gather of the [mesh,
+        One compiled step (scatter-free histogram + all-gather of the [mesh,
         num_parts] matrix to host) followed by two host reductions. The
         host round-trip is tiny and is exactly the reference's "read the
         map-output table before issuing READs" step.
@@ -795,7 +796,7 @@ class ShuffleExchange:
         """
         # The plan's counts matrix is the source of truth for geometry —
         # a mismatched explicit num_parts would silently drop records in
-        # bucket_records' fixed-length bincount.
+        # bucket_records' fixed-length histogram.
         plan_parts = int(plan.counts.shape[1])
         if (num_parts is not None
                 and num_parts * plan.split_factor != plan_parts):

@@ -43,6 +43,36 @@ from jax import lax
 _UNROLL_LIMIT = 16
 
 
+def histogram_pids(part_ids: jax.Array, num_parts: int,
+                   sorted_ids: jax.Array | None = None) -> jax.Array:
+    """Per-partition record counts WITHOUT ``jnp.bincount``.
+
+    bincount lowers to scatter-add, which on TPU is an operand-bound
+    serial disaster — measured ~147ms for 16M records into 8 bins (it
+    was the single largest op in the multi-partition exchange program).
+    Small partition counts use one comparison+reduction pass per
+    partition (~0.3ms each); larger ones binary-search the boundaries
+    of the ALREADY-SORTED pid vector (the caller has it for free from
+    the bucketing sort) — P+1 tiny probes instead of N scattered adds.
+
+    PRECONDITION: pids must lie in ``[0, num_parts)``. Unlike bincount
+    (which clips negatives into bin 0), out-of-range ids are dropped
+    here, which would corrupt the counts/offsets contract downstream —
+    every partitioner in :mod:`sparkrdma_tpu.exchange.partitioners`
+    produces in-range ids by construction (mod/clip).
+    """
+    part_ids = part_ids.astype(jnp.int32)
+    if num_parts <= 32 and sorted_ids is None:
+        return jnp.stack([
+            jnp.sum((part_ids == p).astype(jnp.int32))
+            for p in range(num_parts)])
+    if sorted_ids is None:
+        sorted_ids = jnp.sort(part_ids)
+    edges = jnp.searchsorted(
+        sorted_ids, jnp.arange(num_parts + 1, dtype=jnp.int32))
+    return (edges[1:] - edges[:-1]).astype(jnp.int32)
+
+
 def bucket_records(
     records: jax.Array, part_ids: jax.Array, num_parts: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -53,7 +83,8 @@ def bucket_records(
     and ``offsets[p]`` the start of its run — the exact content of Spark's
     shuffle index file. One fused variadic sort: pid is the key, record
     word columns ride along as values (stable, preserving arrival order
-    within a partition).
+    within a partition); counts come from the sorted pid vector (see
+    :func:`histogram_pids`), not a scatter.
     """
     w, n = records.shape
     if num_parts == 1:
@@ -68,7 +99,7 @@ def bucket_records(
     out = lax.sort((part_ids,) + tuple(records[i] for i in range(w)),
                    num_keys=1, is_stable=True)
     bucketed = jnp.stack(out[1:])
-    counts = jnp.bincount(part_ids, length=num_parts).astype(jnp.int32)
+    counts = histogram_pids(part_ids, num_parts, sorted_ids=out[0])
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
     )
