@@ -124,3 +124,22 @@ def test_compact_segments_program_size_flat_in_segments(rng):
 
     l64, l256 = lowered_len(64), lowered_len(256)
     assert l256 < 1.5 * l64, (l64, l256)
+
+
+def test_histogram_pids_matches_bincount(rng):
+    """Both paths (comparison-sum for small P, searchsorted for large P
+    or pre-sorted ids) must match numpy bincount for in-range pids."""
+    from sparkrdma_tpu.kernels.bucketing import histogram_pids
+
+    for p in (4, 32, 64, 300):
+        pids = rng.integers(0, p, size=5000).astype(np.int32)
+        ref = np.bincount(pids, minlength=p)
+        got = np.asarray(histogram_pids(jnp.asarray(pids), p))
+        np.testing.assert_array_equal(got, ref)
+        got_sorted = np.asarray(histogram_pids(
+            jnp.asarray(pids), p, sorted_ids=jnp.sort(jnp.asarray(pids))))
+        np.testing.assert_array_equal(got_sorted, ref)
+    # empty partitions + everything-in-one-bucket
+    pids = np.full(100, 3, np.int32)
+    got = np.asarray(histogram_pids(jnp.asarray(pids), 8))
+    assert got[3] == 100 and got.sum() == 100
