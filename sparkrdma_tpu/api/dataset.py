@@ -46,17 +46,19 @@ _ID_COUNTER = itertools.count(1 << 20)
 _NULL = np.uint32(0xFFFFFFFF)
 
 
-def _low_word_hash(num_parts: int) -> Callable:
+def _low_word_hash(num_parts: int, key_ix: int) -> Callable:
     """Hash-partition on the LOW key word only — the join key. The
     full-key hash_partitioner would scatter rows that agree on the low
     word but differ in the high word to different devices, silently
-    dropping their matches from a low-word join."""
+    dropping their matches from a low-word join. ``key_ix`` is the low
+    key word's row (``conf.key_words - 1``), not a hardcoded 1, so
+    single-word-key configurations partition on the actual key."""
 
     def part(records):
-        h = records[1] * jnp.uint32(2654435761)
+        h = records[key_ix] * jnp.uint32(2654435761)
         return (h % jnp.uint32(num_parts)).astype(jnp.int32)
 
-    part.cache_key = ("lowhash", num_parts)
+    part.cache_key = ("lowhash", num_parts, key_ix)
     return part
 
 
@@ -67,9 +69,10 @@ _join_programs: "weakref.WeakKeyDictionary[ShuffleManager, Dict[Tuple, Callable]
     = weakref.WeakKeyDictionary()
 
 
-def _join_program(manager: ShuffleManager, ca: int, cb: int) -> Callable:
+def _join_program(manager: ShuffleManager, ca: int, cb: int,
+                  key_ix: int, pay_ix: int) -> Callable:
     cache = _join_programs.setdefault(manager, {})
-    fn = cache.get((ca, cb))
+    fn = cache.get((ca, cb, key_ix, pay_ix))
     if fn is not None:
         return fn
 
@@ -80,13 +83,23 @@ def _join_program(manager: ShuffleManager, ca: int, cb: int) -> Callable:
 
     rt = manager.runtime
     ax = rt.axis_name
+    kw = manager.conf.key_words
     null = jnp.uint32(_NULL)
+
+    def filler(r, cap):
+        # the reservation is ALL key words all-ones (module docstring);
+        # matching on the low word alone would silently drop real rows
+        # whose low word happens to be 0xFFFFFFFF (review finding)
+        m = r[0] == null
+        for k in range(1, kw):
+            m = m & (r[k] == null)
+        return m
 
     def local(ra, ta, rb, tb):
         # mask reserved null-key filler so it can never join with the
-        # other side's filler (both sides' pads share the null low word)
-        va = (jnp.arange(ca) < ta[0]) & (ra[1] != null)
-        vb = (jnp.arange(cb) < tb[0]) & (rb[1] != null)
+        # other side's filler
+        va = (jnp.arange(ca) < ta[0]) & ~filler(ra, ca)
+        vb = (jnp.arange(cb) < tb[0]) & ~filler(rb, cb)
         ra = jnp.where(va[None], ra, jnp.uint32(0))
         rb = jnp.where(vb[None], rb, jnp.uint32(0))
         ta2 = jnp.sum(va).astype(jnp.int32)[None]
@@ -99,7 +112,8 @@ def _join_program(manager: ShuffleManager, ca: int, cb: int) -> Callable:
             rb[i] for i in range(rb.shape[0])), num_keys=1, is_stable=True)
         ra = jnp.stack(sa[1:])
         rb = jnp.stack(sb[1:])
-        c, s = _local_join(ra, ta2, rb, tb2, ca, cb)
+        c, s = _local_join(ra, ta2, rb, tb2, ca, cb,
+                           key_ix=key_ix, pay_ix=pay_ix)
         return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
 
     fn = jax.jit(shard_map(
@@ -107,7 +121,7 @@ def _join_program(manager: ShuffleManager, ca: int, cb: int) -> Callable:
         in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
         out_specs=(P(ax), P(ax)),
     ))
-    cache[(ca, cb)] = fn
+    cache[(ca, cb, key_ix, pay_ix)] = fn
     return fn
 
 
@@ -128,7 +142,20 @@ class Dataset:
     @classmethod
     def from_host_rows(cls, manager: ShuffleManager,
                        rows: np.ndarray) -> "Dataset":
-        """Rows ``[N, W]`` -> device Dataset (N divisible by mesh)."""
+        """Rows ``[N, W]`` -> device Dataset (N divisible by mesh).
+
+        Rejects rows carrying the RESERVED all-ones key (see module
+        docstring): such rows would be silently dropped by
+        ``to_host_rows``/``count``/``join`` later — fail loudly at the
+        boundary instead.
+        """
+        kw = manager.conf.key_words
+        rows = np.asarray(rows)
+        if rows.size and bool((rows[:, :kw] == _NULL).all(axis=1).any()):
+            raise ValueError(
+                "input rows use the reserved all-ones (0xFFFFFFFF) key, "
+                "which this layer reserves for padding filler — remap "
+                "that key before loading")
         return cls(manager, manager.runtime.shard_records(rows))
 
     def to_host_rows(self) -> np.ndarray:
@@ -157,8 +184,17 @@ class Dataset:
                   aggregator: Optional[str] = None,
                   float_payload: bool = False) -> "Dataset":
         m = self.manager
-        sid = next(_ID_COUNTER)
-        handle = m.register_shuffle(sid, num_parts, partitioner)
+        # skip ids the user already registered explicitly on this manager
+        # (documented separation, now enforced): register_shuffle raises
+        # on a duplicate id, so draw until one sticks — public SPI only,
+        # per this module's contract
+        while True:
+            sid = next(_ID_COUNTER)
+            try:
+                handle = m.register_shuffle(sid, num_parts, partitioner)
+                break
+            except ValueError:
+                continue
         try:
             m.get_writer(handle).write(self._dense_records()).stop(True)
             out, totals = m.get_reader(
@@ -231,13 +267,17 @@ class Dataset:
         reserved null key never matches."""
         m = self.manager
         rt = m.runtime
+        if m.conf.val_words < 1:
+            raise ValueError("join_count needs at least one payload word")
+        key_ix = m.conf.key_words - 1        # the low key word
+        pay_ix = m.conf.key_words            # first payload word
         num_parts = rt.num_partitions
-        part = _low_word_hash(num_parts)
+        part = _low_word_hash(num_parts, key_ix)
         a = self._exchange(part, num_parts)
         b = other._exchange(part, num_parts)
         ca = a.records.shape[1] // num_parts
         cb = b.records.shape[1] // num_parts
-        fn = _join_program(m, ca, cb)
+        fn = _join_program(m, ca, cb, key_ix, pay_ix)
         cnt, sm = fn(a.records, a.totals, b.records, b.totals)
         return int(np.asarray(cnt)[0]), float(np.asarray(sm)[0])
 

@@ -254,6 +254,17 @@ class ShuffleExchange:
                                     part_fn)
                 self._count_cache[key] = fn
             counts = np.asarray(jax.device_get(fn(records))).astype(np.int64)
+            if int(counts.sum()) != records.shape[1]:
+                # histogram_pids drops out-of-range ids (its documented
+                # precondition); catching the shortfall HERE — the one
+                # host-visible point every shuffle passes through — turns
+                # a buggy user partitioner into a loud error instead of
+                # quiet record loss downstream (round-3 advisor finding)
+                raise ValueError(
+                    f"partitioner produced out-of-range partition ids: "
+                    f"counted {int(counts.sum())} of {records.shape[1]} "
+                    f"records over {parts} partitions (ids must lie in "
+                    f"[0, num_parts))")
             per_pair_max = int(counts.max(initial=0))
             if explicit_capacity is not None:
                 cap = explicit_capacity

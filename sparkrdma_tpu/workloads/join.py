@@ -42,40 +42,44 @@ class JoinResult:
     verified: Optional[bool] = None
 
 
-def _local_join(cols_a, total_a, cols_b, total_b, cap_a, cap_b):
+def _local_join(cols_a, total_a, cols_b, total_b, cap_a, cap_b,
+                key_ix: int = 1, pay_ix: int = 2):
     """Per-device sort-merge join -> (count, sum of payload products).
 
     Inputs are columnar ``[W, cap]`` batches. Sorts both sides by the lo
     key word (one fused variadic sort per side, payload riding along),
     then for each A record looks up B's per-key aggregate via two
-    searchsorteds — no pair materialization. Payloads are the word right
-    after the 2 key words, accumulated as float32 sums.
+    searchsorteds — no pair materialization. ``key_ix``/``pay_ix`` locate
+    the join-key and payload words (``conf.key_words - 1`` and
+    ``conf.key_words`` for callers with non-default key widths);
+    payloads are accumulated as float32 sums.
     """
-    ka = cols_a[1]
-    kb = cols_b[1]
+    ka = cols_a[key_ix]
+    kb = cols_b[key_ix]
     va = jnp.arange(cap_a) < total_a[0]
     vb = jnp.arange(cap_b) < total_b[0]
 
-    # substitute a sentinel for padding keys BEFORE sorting and keep the
-    # substituted values: padding must sort to the tail and stay there,
-    # or searchsorted ranges would sweep padding rows in
+    # substitute a sentinel for padding keys BEFORE sorting: padding
+    # sorts to the tail as a block. A VALID record may itself carry the
+    # sentinel key value, so validity (not position vs total) decides
+    # what counts: both the match count and the payload sum aggregate
+    # the validity-masked values over the searchsorted range, which
+    # makes interleaved padding contribute exactly zero.
     ka = jnp.where(va, ka, jnp.uint32(0xFFFFFFFF))
     kb = jnp.where(vb, kb, jnp.uint32(0xFFFFFFFF))
-    sa, pa, va_s = jax.lax.sort((ka, cols_a[2], va), num_keys=1,
+    sa, pa, va_s = jax.lax.sort((ka, cols_a[pay_ix], va), num_keys=1,
                                 is_stable=True)
-    sb, pb, vb_s = jax.lax.sort((kb, cols_b[2], vb), num_keys=1,
+    sb, pb, vb_s = jax.lax.sort((kb, cols_b[pay_ix], vb), num_keys=1,
                                 is_stable=True)
 
     # B per-key prefix sums for O(log n) range aggregation
     pb_f = pb.astype(jnp.float32) * vb_s
     csum = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(pb_f)])
+    ccnt = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(vb_s.astype(jnp.int32))])
     lo = jnp.searchsorted(sb, sa, side="left")
     hi = jnp.searchsorted(sb, sa, side="right")
-    # clamp lookups into the valid region of B
-    nb = total_b[0]
-    lo = jnp.minimum(lo, nb)
-    hi = jnp.minimum(hi, nb)
-    cnt_per_a = (hi - lo) * va_s
+    cnt_per_a = (jnp.take(ccnt, hi) - jnp.take(ccnt, lo)) * va_s
     sum_per_a = (jnp.take(csum, hi) - jnp.take(csum, lo)) * va_s
     count = jnp.sum(cnt_per_a).astype(jnp.int32)
     prods = jnp.sum(pa.astype(jnp.float32) * sum_per_a)
@@ -103,13 +107,20 @@ def run_hash_join(
     the sides provably disjoint — the zero-match path)."""
     rt = manager.runtime
     mesh = rt.num_partitions
-    w = manager.conf.record_words
+    conf = manager.conf
+    w = conf.record_words
+    if conf.val_words < 1:
+        raise ValueError("hash join needs at least one payload word")
+    # the join key is the LOW key word; payload is the word after the
+    # keys — derived from conf, not a hardcoded key_words==2 layout
+    key_ix = conf.key_words - 1
+    pay_ix = conf.key_words
     rng = np.random.default_rng(seed)
 
     def gen(n, key_offset):
         x = np.zeros((mesh * n, w), dtype=np.uint32)
-        x[:, 1] = rng.integers(0, key_range, size=mesh * n) + key_offset
-        x[:, 2] = rng.integers(1, 1000, size=mesh * n)       # payload
+        x[:, key_ix] = rng.integers(0, key_range, size=mesh * n) + key_offset
+        x[:, pay_ix] = rng.integers(1, 1000, size=mesh * n)   # payload
         return x
 
     xa = gen(rows_per_device_a, 0)
@@ -135,11 +146,12 @@ def run_hash_join(
     ax = rt.axis_name
 
     cache = _join_cache.setdefault(manager, {})
-    cache_key = (ca, cb)
+    cache_key = (ca, cb, key_ix, pay_ix)
     joined = cache.get(cache_key)
     if joined is None:
         def local(rows_a, total_a, rows_b, total_b):
-            c, s = _local_join(rows_a, total_a, rows_b, total_b, ca, cb)
+            c, s = _local_join(rows_a, total_a, rows_b, total_b, ca, cb,
+                               key_ix=key_ix, pay_ix=pay_ix)
             return (jax.lax.psum(c, ax)[None], jax.lax.psum(s, ax)[None])
 
         joined = jax.jit(shard_map(
@@ -159,7 +171,7 @@ def run_hash_join(
 
     verified = None
     if verify:
-        ref_count, ref_sum = _numpy_reference_join(xa, xb)
+        ref_count, ref_sum = _numpy_reference_join(xa, xb, key_ix, pay_ix)
         verified = (count == ref_count
                     and abs(prods - ref_sum) <= 1e-6 * max(1.0, abs(ref_sum)))
     return JoinResult(
@@ -169,9 +181,11 @@ def run_hash_join(
     )
 
 
-def _numpy_reference_join(xa: np.ndarray, xb: np.ndarray) -> Tuple[int, float]:
-    ka, pa = xa[:, 1], xa[:, 2].astype(np.float64)
-    kb, pb = xb[:, 1], xb[:, 2].astype(np.float64)
+def _numpy_reference_join(xa: np.ndarray, xb: np.ndarray,
+                          key_ix: int = 1,
+                          pay_ix: int = 2) -> Tuple[int, float]:
+    ka, pa = xa[:, key_ix], xa[:, pay_ix].astype(np.float64)
+    kb, pb = xb[:, key_ix], xb[:, pay_ix].astype(np.float64)
     sum_b: Dict[int, float] = {}
     cnt_b: Dict[int, int] = {}
     for k, p in zip(kb, pb):

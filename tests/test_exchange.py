@@ -363,3 +363,18 @@ def test_single_device_degenerate_exchange(rng):
         np.testing.assert_array_equal(canon(got), canon(x))
     finally:
         rt.stop()
+
+
+def test_plan_rejects_out_of_range_partitioner(exchange, rng):
+    """A buggy partitioner emitting ids outside [0, num_parts) must fail
+    loudly at plan time, not silently understate counts (round-3
+    advisor finding on histogram_pids' drop semantics)."""
+    ex, rt = exchange
+    records, _ = make_global_records(rng, rt, 32)
+
+    def bad_part(records):
+        return jnp.full((records.shape[1],), 9, jnp.int32)  # >= num_parts
+
+    bad_part.cache_key = ("bad", 9)
+    with pytest.raises(ValueError, match="out-of-range"):
+        ex.plan(records, bad_part, num_parts=8)
