@@ -125,6 +125,66 @@ def _join_program(manager: ShuffleManager, ca: int, cb: int,
     return fn
 
 
+def _join_rows_program(manager: ShuffleManager, ca: int, cb: int,
+                       out_capacity: int, key_ix: int,
+                       count_only: bool = False) -> Callable:
+    """Compiled per-device row-materializing join (or its counting pass).
+
+    Shares :func:`_join_program`'s filler handling: reserved null-key
+    rows are masked out and each side re-compacted valid-first before
+    the sort-merge join. Cached per manager + geometry.
+    """
+    cache = _join_programs.setdefault(manager, {})
+    ck = ("rows", ca, cb, out_capacity, key_ix, count_only)
+    fn = cache.get(ck)
+    if fn is not None:
+        return fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from sparkrdma_tpu.utils.compat import shard_map
+    from sparkrdma_tpu.workloads.join import _local_join_rows
+
+    rt = manager.runtime
+    ax = rt.axis_name
+    kw = manager.conf.key_words
+    vw = manager.conf.val_words
+    null = jnp.uint32(_NULL)
+
+    def strip_filler(r, t, cap):
+        m = r[0] == null
+        for k in range(1, kw):
+            m = m & (r[k] == null)
+        v = (jnp.arange(cap) < t[0]) & ~m
+        r = jnp.where(v[None], r, jnp.uint32(0))
+        s = jax.lax.sort(((~v).astype(jnp.uint8),) + tuple(
+            r[i] for i in range(r.shape[0])), num_keys=1, is_stable=True)
+        return jnp.stack(s[1:]), jnp.sum(v).astype(jnp.int32)[None]
+
+    def local(ra, ta, rb, tb):
+        ra, ta = strip_filler(ra, ta, ca)
+        rb, tb = strip_filler(rb, tb, cb)
+        if count_only:
+            # the counting leg of _local_join (validity-rank math) —
+            # per-device counts, no psum: each device sizes its own slice
+            c, _ = _local_join(ra, ta, rb, tb, ca, cb,
+                               key_ix=key_ix, pay_ix=kw)
+            return c[None]
+        joined, count = _local_join_rows(ra, ta, rb, tb, out_capacity,
+                                         key_ix, kw, vw, vw)
+        return joined, count[None]
+
+    from sparkrdma_tpu.workloads.join import _local_join
+
+    fn = jax.jit(shard_map(
+        local, mesh=rt.mesh,
+        in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+        out_specs=(P(ax) if count_only else (P(None, ax), P(ax))),
+    ))
+    cache[ck] = fn
+    return fn
+
+
 class Dataset:
     """A distributed batch of fixed-width records with Spark-ish verbs."""
 
@@ -280,6 +340,67 @@ class Dataset:
         fn = _join_program(m, ca, cb, key_ix, pay_ix)
         cnt, sm = fn(a.records, a.totals, b.records, b.totals)
         return int(np.asarray(cnt)[0]), float(np.asarray(sm)[0])
+
+    def join(self, other: "Dataset",
+             out_capacity: Optional[int] = None
+             ) -> Tuple[jax.Array, np.ndarray]:
+        """MATERIALIZED inner join on the LOW key word (rdd.join):
+        returns ``(joined_cols, totals)``.
+
+        ``joined_cols``: columnar ``uint32[key_words + 2*val_words,
+        mesh * out_capacity]`` — per device, the first ``totals[d]``
+        columns are joined rows ``(key words, A payload, B payload)``;
+        tail is zero padding. Row multiplicity is the full M×N product
+        of matching keys per device, like Spark's join.
+
+        ``out_capacity``: per-device output capacity. ``None`` (default)
+        runs a cheap counting pass first and sizes it exactly (the
+        two-phase plan/execute structure of the exchange itself). An
+        explicit capacity smaller than a device's true match count
+        raises — the fixed-capacity overflow contract of ``compact``,
+        surfaced loudly here because the verb layer has no way to hand
+        back the missing rows.
+        """
+        m = self.manager
+        rt = m.runtime
+        if m.conf.val_words < 1:
+            raise ValueError("join needs at least one payload word")
+        key_ix = m.conf.key_words - 1
+        num_parts = rt.num_partitions
+        part = _low_word_hash(num_parts, key_ix)
+        a = self._exchange(part, num_parts)
+        b = other._exchange(part, num_parts)
+        ca = a.records.shape[1] // num_parts
+        cb = b.records.shape[1] // num_parts
+        if out_capacity is None:
+            count_fn = _join_rows_program(m, ca, cb, 0, key_ix,
+                                          count_only=True)
+            per_dev = np.asarray(count_fn(a.records, a.totals,
+                                          b.records, b.totals))
+            from sparkrdma_tpu.config import size_class
+
+            out_capacity = size_class(max(1, int(per_dev.max())))
+        fn = _join_rows_program(m, ca, cb, out_capacity, key_ix)
+        joined, totals = fn(a.records, a.totals, b.records, b.totals)
+        totals = np.asarray(totals)
+        if int(totals.max(initial=0)) > out_capacity:
+            raise ValueError(
+                f"join overflow: a device matched {int(totals.max())} "
+                f"rows > out_capacity {out_capacity}; pass a larger "
+                "out_capacity (or None to auto-size)")
+        return jnp.array(joined), totals
+
+    @staticmethod
+    def collect_rows(cols: jax.Array, totals: np.ndarray) -> np.ndarray:
+        """Valid rows of a padded columnar result (e.g. :meth:`join`'s
+        output), concatenated in device order."""
+        totals = np.asarray(totals)
+        mesh = totals.shape[0]
+        cap = cols.shape[1] // mesh
+        arr = np.asarray(cols)
+        return np.concatenate(
+            [arr[:, d * cap:d * cap + int(totals[d])].T
+             for d in range(mesh)])
 
 
 __all__ = ["Dataset"]

@@ -652,10 +652,12 @@ class ShuffleManager:
 
             ax = self.runtime.axis_name
 
+            wide = self._exchange._wide_sort(out.shape[0])
+
             def local_agg(cols, total):
                 valid = jnp.arange(cap) < total[0]
                 combined, nuniq = combine_by_key_cols(
-                    cols, valid, key_words, op, float_payload)
+                    cols, valid, key_words, op, float_payload, wide=wide)
                 return combined, nuniq[None]
 
             fn = jax.jit(shard_map(
@@ -683,15 +685,19 @@ class ShuffleManager:
 
             from sparkrdma_tpu.kernels.merge_sort import (merge_sort_cols,
                                                           supports_fast_sort)
+            from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
             fast = (self.conf.fast_sort
                     and supports_fast_sort(cap, self.conf.fast_sort_run))
+            wide = self._exchange._wide_sort(w)
 
             def local_sort(cols, total):
                 valid = jnp.arange(cap) < total[0]
                 if fast:   # same contract note as the fused tail
                     return merge_sort_cols(cols, valid,
                                            run=self.conf.fast_sort_run)
+                if wide:
+                    return sort_wide_cols(cols, key_words, valid)
                 return lexsort_cols(cols, key_words, valid)
 
             fn = jax.jit(shard_map(

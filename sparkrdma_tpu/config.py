@@ -122,6 +122,16 @@ class ShuffleConf:
     #: fast path at CPU-mesh sizes.
     fast_sort_run: int = 1 << 15
 
+    #: payload width (in uint32 words) at or above which key-ordering
+    #: sorts use the WIDE-RECORD path: a 3-4 operand (keys, index) sort
+    #: plus one gather pass placing the payload, instead of riding every
+    #: payload word through lax.sort's O(log^2 N) comparator network.
+    #: Two separate wins at HiBench-faithful 100B records (23 payload
+    #: words): the comparator moves ~8x less data, and compile time
+    #: drops from ~14min (25-operand variadic sort, measured round 3)
+    #: to seconds. 0 disables (always ride).
+    wide_sort_min_payload: int = 8
+
     # --- observability ---
     collect_shuffle_read_stats: bool = False
 
@@ -150,6 +160,8 @@ class ShuffleConf:
                 f"lane-width tile minimum), got {self.fast_sort_run}")
         if self.hierarchy_hosts < 0:
             raise ValueError("hierarchy_hosts must be >= 0")
+        if self.wide_sort_min_payload < 0:
+            raise ValueError("wide_sort_min_payload must be >= 0")
         if self.geometry_classes not in ("pow2", "fine"):
             raise ValueError(
                 f"unknown geometry_classes {self.geometry_classes!r}")

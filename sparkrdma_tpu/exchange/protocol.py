@@ -357,15 +357,18 @@ class ShuffleExchange:
         full (totals == out_capacity), so the sort can drop its
         validity lead operand — one fewer array through the comparator
         network."""
+        wide = self._wide_sort(out.shape[0])
         if aggregator:
             from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
 
             valid = jnp.arange(out_capacity) < total
             out, total = combine_by_key_cols(
-                out, valid, self.conf.key_words, aggregator, float_payload)
+                out, valid, self.conf.key_words, aggregator, float_payload,
+                wide=wide)
         elif sort_key_words:
             from sparkrdma_tpu.kernels.merge_sort import merge_sort_cols
             from sparkrdma_tpu.kernels.sort import lexsort_cols
+            from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
             valid = (None if tight_out
                      else jnp.arange(out_capacity) < total)
@@ -377,9 +380,16 @@ class ShuffleExchange:
                 # sortByKey. Stability needed? conf.fast_sort=False.
                 out = merge_sort_cols(out, valid,
                                       run=self.conf.fast_sort_run)
+            elif wide:
+                out = sort_wide_cols(out, sort_key_words, valid)
             else:
                 out = lexsort_cols(out, sort_key_words, valid)
         return out, total
+
+    def _wide_sort(self, record_words: int) -> bool:
+        """Payload wide enough for the key+index sort + placement path?"""
+        t = self.conf.wide_sort_min_payload
+        return bool(t) and record_words - self.conf.key_words >= t
 
     # ------------------------------------------------------------------
     # phase 2, regime A: one fused program
@@ -434,7 +444,9 @@ class ShuffleExchange:
 
             # --- map side: bucket into per-partition runs -------------
             pids = partitioner(records).astype(jnp.int32)
-            sr, counts, offs = bucket_records(records, pids, num_parts)
+            sr, counts, offs = bucket_records(records, pids, num_parts,
+                                              wide=self._wide_sort(
+                                                  records.shape[0]))
 
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
@@ -518,7 +530,9 @@ class ShuffleExchange:
 
         def local_prep(records):
             pids = partitioner(records).astype(jnp.int32)
-            sr, counts, offs = bucket_records(records, pids, num_parts)
+            sr, counts, offs = bucket_records(records, pids, num_parts,
+                                              wide=self._wide_sort(
+                                                  records.shape[0]))
             dev_counts = _device_partition_counts(
                 counts, num_parts, mesh_size, ax)
             incoming = lax.all_to_all(

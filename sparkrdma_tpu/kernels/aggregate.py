@@ -4,7 +4,22 @@ The reference's reduce path hands fetched blocks to Spark's optional
 ``Aggregator`` (map-side combine / reduce-side merge in
 RdmaShuffleReader §read). TPU-native equivalent: after the exchange, sort
 the received records by key and segment-reduce runs of equal keys — fixed
-shapes, VPU-friendly, no hash tables.
+shapes, VPU-friendly, no hash tables, and (critically) NO SCATTER OPS.
+
+Scatter-free design: on TPU, ``jax.ops.segment_sum`` and ``.at[].set``
+lower to scatter, an operand-bound serial disaster this repo has measured
+repeatedly (16M-element scatter ≈ 1.4s; the 147ms bincount scatter-add
+was round 3's headline kill, kernels/bucketing.py §histogram_pids). The
+replacement pipeline is three parallel-friendly primitives:
+
+1. one stable variadic ``lax.sort`` groups equal keys into runs;
+2. a SEGMENTED ASSOCIATIVE SCAN (``lax.associative_scan`` over
+   ``(value, boundary_flag)`` pairs — the classic segmented-scan
+   operator) leaves each run's full reduction in its LAST row:
+   log2(N) elementwise passes, no data movement across lanes beyond
+   XLA's own scan slicing;
+3. one more stable sort keyed on "is last of run" compacts the unique
+   keys (already in ascending key order) to the front.
 
 Core is columnar (``uint32[W, N]`` batches, matching the exchange data
 path); thin row-major wrappers remain for host-scale callers and tests.
@@ -18,8 +33,30 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from sparkrdma_tpu.kernels.sort import lexsort_cols
+
+
+def _segmented_scan(vals: jax.Array, first: jax.Array, op) -> jax.Array:
+    """Inclusive left-to-right scan of ``op`` over ``vals: [P, N]`` with
+    segment resets where ``first: bool[N]`` is True.
+
+    The classic segmented-scan pair operator: combining summaries
+    ``(va, fa) ⊕ (vb, fb) = (fb ? vb : op(va, vb), fa | fb)`` — if the
+    right block contains a segment head, the left block's accumulation
+    must not leak into it. Associative, so ``lax.associative_scan``
+    parallelizes it in log2(N) elementwise passes.
+    """
+    flags = first[None, :]
+
+    def combine(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, op(va, vb)), fa | fb
+
+    out, _ = lax.associative_scan(combine, (vals, flags), axis=1)
+    return out
 
 
 def combine_by_key_cols(
@@ -28,15 +65,24 @@ def combine_by_key_cols(
     key_words: int,
     op: str = "sum",
     float_payload: bool = False,
+    wide: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Reduce payloads of equal keys; return ``(combined, num_unique)``.
 
     ``cols: uint32[W, N]`` with leading ``key_words`` key rows. Output
     keeps shape ``[W, N]``: the first ``num_unique`` columns are unique
     keys (sorted ascending) with reduced payloads; tail is zero padding.
+    ``wide`` routes both sorts through the key+index wide-record path
+    (kernels/wide_sort.py) so wide payloads never ride the comparator
+    network — same contract, chosen by the caller's record geometry.
     """
     w, n = cols.shape
-    srt = lexsort_cols(cols, key_words, valid)
+    if wide:
+        from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
+
+        srt = sort_wide_cols(cols, key_words, valid)
+    else:
+        srt = lexsort_cols(cols, key_words, valid)
     nvalid = jnp.sum(valid).astype(jnp.int32)
     in_valid = jnp.arange(n) < nvalid
     keys = srt[:key_words]                       # [kw, N]
@@ -46,37 +92,44 @@ def combine_by_key_cols(
 
     eq = jnp.all(keys[:, 1:] == keys[:, :-1], axis=0)
     same = jnp.concatenate([jnp.zeros((1,), bool), eq]) & in_valid
-    # segment id per record: 0-based index of its unique key
-    seg = jnp.cumsum((~same & in_valid).astype(jnp.int32)) - 1
-    seg = jnp.where(in_valid, seg, n)  # padding -> out-of-range id
-    num_unique = jnp.where(nvalid > 0, seg[jnp.maximum(nvalid - 1, 0)] + 1, 0)
+    first_of_run = (~same) & in_valid
+    num_unique = jnp.sum(first_of_run).astype(jnp.int32)
 
-    # segment ops over the record axis, payload words batched on axis 0
-    pT = payload.T                               # [N, W-kw]
     if op == "sum":
-        red = jax.ops.segment_sum(pT, seg, num_segments=n)
+        red = _segmented_scan(payload, first_of_run, jnp.add)
     elif op == "min":
-        red = jax.ops.segment_min(pT, seg, num_segments=n)
+        red = _segmented_scan(payload, first_of_run, jnp.minimum)
     elif op == "max":
-        red = jax.ops.segment_max(pT, seg, num_segments=n)
+        red = _segmented_scan(payload, first_of_run, jnp.maximum)
     else:
         raise ValueError(f"unsupported op {op!r}")
-    red = red.T                                  # [W-kw, N]
     if float_payload:
         red = jax.lax.bitcast_convert_type(red, jnp.uint32)
 
-    # representative key per segment: the first record of each run
-    first_of_run = (~same) & in_valid
-    dst = jnp.where(first_of_run, seg, n)
-    seg_keys = (
-        jnp.zeros((n, key_words), jnp.uint32)
-        .at[dst]
-        .set(keys.T, mode="drop")
-    ).T
-    out = jnp.concatenate([seg_keys, red.astype(jnp.uint32)], axis=0)
+    # the LAST row of each run now holds the run's full reduction (and
+    # its key words — all rows of a run share the key); compact those
+    # rows to the front with one stable validity-lead sort, preserving
+    # ascending key order
+    next_same = jnp.concatenate([same[1:], jnp.zeros((1,), bool)])
+    last_of_run = in_valid & ~next_same
+    lead = (~last_of_run).astype(jnp.uint8)
+    if wide:
+        # compact via a 2-operand (flag, index) sort + one gather pass
+        # instead of riding all W words through the network again
+        from sparkrdma_tpu.kernels.wide_sort import apply_perm
+
+        idx = lax.iota(jnp.int32, n)
+        _, perm = lax.sort((lead, idx), num_keys=1, is_stable=True)
+        full = jnp.concatenate([keys, red], axis=0)
+        out = apply_perm(full.T, perm).T
+    else:
+        operands = (lead,) + tuple(keys[i] for i in range(key_words)) \
+            + tuple(red[i] for i in range(w - key_words))
+        packed = lax.sort(operands, num_keys=1, is_stable=True)
+        out = jnp.stack(packed[1:])
     live = (jnp.arange(n) < num_unique)[None, :]
     out = out * live.astype(out.dtype)
-    return out, num_unique.astype(jnp.int32)
+    return out, num_unique
 
 
 def combine_by_key(

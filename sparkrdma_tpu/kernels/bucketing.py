@@ -74,7 +74,8 @@ def histogram_pids(part_ids: jax.Array, num_parts: int,
 
 
 def bucket_records(
-    records: jax.Array, part_ids: jax.Array, num_parts: int
+    records: jax.Array, part_ids: jax.Array, num_parts: int,
+    wide: bool = False
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Stable-sort a columnar batch ``[W, N]`` by destination partition.
 
@@ -85,6 +86,11 @@ def bucket_records(
     word columns ride along as values (stable, preserving arrival order
     within a partition); counts come from the sorted pid vector (see
     :func:`histogram_pids`), not a scatter.
+
+    ``wide``: for wide records, sort only ``(pid, index)`` and place the
+    record words with one gather pass instead of riding all ``W`` word
+    columns through the comparator network (see kernels/wide_sort.py's
+    rationale — same cost structure on the map side).
     """
     w, n = records.shape
     if num_parts == 1:
@@ -96,6 +102,19 @@ def bucket_records(
                 jnp.full((1,), n, jnp.int32),
                 jnp.zeros((1,), jnp.int32))
     part_ids = part_ids.astype(jnp.int32)
+    if wide:
+        from sparkrdma_tpu.kernels.wide_sort import apply_perm
+
+        idx = lax.iota(jnp.int32, n)
+        sorted_ids, perm = lax.sort((part_ids, idx), num_keys=1,
+                                    is_stable=True)
+        bucketed = apply_perm(records.T, perm).T
+        counts = histogram_pids(part_ids, num_parts, sorted_ids=sorted_ids)
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+        )
+        return bucketed, counts, offsets
     out = lax.sort((part_ids,) + tuple(records[i] for i in range(w)),
                    num_keys=1, is_stable=True)
     bucketed = jnp.stack(out[1:])

@@ -86,6 +86,75 @@ def _local_join(cols_a, total_a, cols_b, total_b, cap_a, cap_b,
     return count, prods
 
 
+def _local_join_rows(cols_a, total_a, cols_b, total_b, out_capacity,
+                     key_ix, kw, val_a, val_b):
+    """Per-device sort-merge join MATERIALIZING the joined rows.
+
+    Spark joins produce row streams; the TPU-native form is a
+    fixed-capacity output with an overflow contract (the same contract
+    :func:`~sparkrdma_tpu.kernels.sort.compact` uses): returns
+    ``(joined [kw + val_a + val_b, out_capacity], count)`` where
+    ``count`` is the TRUE match count — ``count > out_capacity`` means
+    the caller's capacity was too small and rows beyond it are absent.
+
+    Joined row layout: A's key words, then A's payload words, then B's
+    payload words (the standard ``(k, (va, vb))`` pair of ``rdd.join``).
+
+    Mechanics (all fixed-shape, scatter-free): sort both sides by the
+    join key (full records ride — test/aggregate-scale path); per A row
+    ``i`` a searchsorted range ``[lo_i, hi_i)`` of B matches; exclusive
+    cumsum of match counts gives each A row's output offset; every
+    output slot ``j`` then locates its (A row, B row) pair by one
+    searchsorted back into the offsets — a gather, not a scatter.
+    """
+    cap_a = cols_a.shape[1]
+    cap_b = cols_b.shape[1]
+    va = jnp.arange(cap_a) < total_a[0]
+    vb = jnp.arange(cap_b) < total_b[0]
+    ka = jnp.where(va, cols_a[key_ix], jnp.uint32(0xFFFFFFFF))
+    kb = jnp.where(vb, cols_b[key_ix], jnp.uint32(0xFFFFFFFF))
+    sa = jax.lax.sort((ka, va) + tuple(cols_a[i] for i in range(cols_a.shape[0])),
+                      num_keys=1, is_stable=True)
+    sb = jax.lax.sort((kb, vb) + tuple(cols_b[i] for i in range(cols_b.shape[0])),
+                      num_keys=1, is_stable=True)
+    ka_s, va_s = sa[0], sa[1]
+    a_rows = jnp.stack(sa[2:])                     # [Wa, cap_a] sorted
+    kb_s, vb_s = sb[0], sb[1]
+    b_rows = jnp.stack(sb[2:])                     # [Wb, cap_b] sorted
+
+    # per-A-row match range in B, counted by validity (a valid record
+    # may carry the sentinel key value — same rule as _local_join)
+    ccnt = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(vb_s.astype(jnp.int32))])
+    lo = jnp.searchsorted(kb_s, ka_s, side="left")
+    hi = jnp.searchsorted(kb_s, ka_s, side="right")
+    cnt = (jnp.take(ccnt, hi) - jnp.take(ccnt, lo)) * va_s
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(cnt).astype(jnp.int32)])
+    count = starts[-1]
+
+    # output slot j -> (A row, B row). B's valid matches for an A row
+    # are contiguous in the validity-cumsum domain, so the B row is
+    # found by inverting ccnt at (ccnt[lo] + offset-within-range).
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    a_ix = jnp.clip(jnp.searchsorted(starts, j, side="right") - 1,
+                    0, cap_a - 1)
+    off = j - jnp.take(starts, a_ix)
+    b_rank = jnp.take(ccnt, jnp.take(lo, a_ix)) + off   # validity rank
+    # first B position with ccnt[pos+1] == b_rank+1 (i.e. the b_rank-th
+    # valid row): searchsorted over the inclusive cumsum
+    b_ix = jnp.clip(jnp.searchsorted(ccnt[1:], b_rank + 1, side="left"),
+                    0, cap_b - 1)
+    live = j < jnp.minimum(count, out_capacity)
+
+    a_sel = jnp.take(a_rows, a_ix, axis=1)         # [Wa, out_cap]
+    b_sel = jnp.take(b_rows, b_ix, axis=1)         # [Wb, out_cap]
+    joined = jnp.concatenate(
+        [a_sel[:kw], a_sel[kw:kw + val_a], b_sel[kw:kw + val_b]], axis=0)
+    joined = joined * live[None].astype(joined.dtype)
+    return joined, count
+
+
 #: Compiled local-join cache, scoped per manager (weak, so dropping the
 #: manager frees its compiled programs) and keyed by capacities —
 #: re-jitting per call would make join_s measure trace+compile.
