@@ -1,0 +1,218 @@
+"""Larger-than-HBM TeraSort: chunked input, per-chunk shuffle+sort,
+host-spilled sorted runs — Spark's ExternalSorter shape at TPU scale.
+
+The reference sorts datasets far larger than any node's memory: map
+outputs live in files, reducers stream exact byte ranges through bounded
+registered buffers, and Spark's ``ExternalSorter`` merges spilled sorted
+runs (SURVEY.md §3.3, §5 long-context row). The TPU-native equivalent
+keeps HBM residency BOUNDED at ~one chunk regardless of dataset size:
+
+    host dataset (RAM or spill files, any size)
+      └─ InputStreamer: H2D of chunk j+1 overlaps chunk j's exchange
+           └─ per chunk: range-partition exchange + fused per-device sort
+                └─ run consumption:
+                   - ``spill``: D2H + pipelined SpillWriter → per-device
+                     SORTED RUNS on disk (the ExternalSorter spill leg);
+                     a k-way merge of device d's runs is device d's
+                     final sorted stream (identical splitters every
+                     chunk → device boundaries already ascend)
+                   - no spill: fold conservation sums into a tiny device
+                     accumulator (pure-throughput mode for benches)
+
+Every chunk reuses ONE exchange geometry (explicit slot capacity), so
+the whole stream runs through the same compiled programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import range_partitioner
+from sparkrdma_tpu.hbm.host_staging import SpillWriter
+from sparkrdma_tpu.hbm.input_stream import InputStreamer
+from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+from sparkrdma_tpu.utils.stats import barrier
+
+
+@dataclasses.dataclass
+class StreamingSortResult:
+    chunks: int
+    records: int
+    record_bytes: int
+    stream_s: float
+    verified: Optional[bool]
+    run_paths: Sequence[str] = ()
+
+    @property
+    def total_bytes(self) -> int:
+        return self.records * self.record_bytes
+
+    @property
+    def gbps(self) -> float:
+        return self.total_bytes / max(self.stream_s, 1e-9) / 1e9
+
+
+def run_streaming_terasort(
+    manager: ShuffleManager,
+    source,
+    spill_dir: Optional[str] = None,
+    verify: bool = False,
+    samples_per_device: int = 256,
+    shuffle_id_base: int = 9000,
+) -> StreamingSortResult:
+    """Shuffle+sort a chunked host dataset of unbounded size.
+
+    ``source``: an :class:`~sparkrdma_tpu.hbm.input_stream
+    .ArrayChunkSource` / ``FileChunkSource`` of columnar chunks.
+    ``spill_dir``: write each chunk's per-device sorted run to disk
+    (``run-<chunk>-dev<d>.bin``) through the pipelined
+    :class:`SpillWriter`; ``None`` folds conservation sums on device
+    instead (bounded-memory throughput mode).
+
+    ``verify`` (host, test-scale): k-way-merges the spilled runs per
+    device and checks the merged global stream is sorted and a
+    permutation of the input chunks.
+    """
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    kw = manager.conf.key_words
+    streamer = InputStreamer(rt, source)
+    n_chunks = len(streamer)
+    if n_chunks == 0:
+        raise ValueError("empty chunk source")
+
+    # splitters from the FIRST chunk's on-fabric sample; identical for
+    # every chunk, so per-device key ranges are stable across the stream
+    first = next(iter(InputStreamer(rt, source)))
+    sampler = make_sampler(rt.mesh, rt.axis_name, kw, samples_per_device)
+    splitters = compute_splitters(
+        np.asarray(jax.device_get(sampler(first))), mesh)
+    part = range_partitioner(splitters, kw)
+    del first
+
+    spiller = SpillWriter(use_native=manager.conf.use_native_staging) \
+        if spill_dir else None
+    run_paths = []
+    acc = None          # conservation accumulator (no-spill mode)
+    fold = None
+    records = 0
+    w = None
+
+    t0 = time.perf_counter()
+    for j, chunk in enumerate(streamer):
+        w = chunk.shape[0]
+        records += chunk.shape[1]
+        handle = manager.register_shuffle(shuffle_id_base + j, mesh, part)
+        try:
+            manager.get_writer(handle).write(chunk).stop(True)
+            out, totals = manager.get_reader(
+                handle, key_ordering=True).read(record_stats=False)
+            if spiller is not None:
+                # D2H then pipelined disk writes: the spooler's writer
+                # thread persists run j while chunk j+1 is already in
+                # flight H2D (InputStreamer) and on the fabric
+                host = np.asarray(out)
+                tot = np.asarray(totals)
+                cap = host.shape[1] // mesh
+                for d in range(mesh):
+                    path = os.path.join(spill_dir,
+                                        f"run-{j}-dev{d}.bin")
+                    k = int(tot[d])
+                    spiller.submit(path,
+                                   host[:, d * cap:d * cap + k].T)
+                    run_paths.append((path, k))
+            else:
+                if fold is None:
+                    fold = _make_fold(w)
+                    acc = jnp.zeros((w + 1,), jnp.uint32)
+                acc = fold(acc, out, totals)
+        finally:
+            manager.unregister_shuffle(shuffle_id_base + j)
+    if spiller is not None:
+        errors = spiller.drain()
+        spiller.close()
+        if errors:
+            raise OSError(f"{errors} spill writes failed")
+    else:
+        barrier(acc)
+    stream_s = time.perf_counter() - t0
+
+    verified = None
+    if verify and spill_dir:
+        verified = _verify_runs(source, run_paths, mesh, kw, w)
+    return StreamingSortResult(
+        chunks=n_chunks, records=records, record_bytes=4 * (w or 0),
+        stream_s=stream_s, verified=verified,
+        run_paths=tuple(p for p, _ in run_paths),
+    )
+
+
+def _make_fold(w: int):
+    """Tiny donated-accumulator fold: per-chunk (count, per-word sums)."""
+
+    @jax.jit
+    def fold(acc, out, totals):
+        total = jnp.sum(totals).astype(jnp.uint32)
+        sums = jnp.sum(out, axis=1, dtype=jnp.uint32)
+        return acc + jnp.concatenate([total[None], sums])
+
+    return fold
+
+
+def _verify_runs(source, run_paths, mesh, kw, w) -> bool:
+    """Host-side external-merge proof (test scale): device streams are
+    sorted, ascend across devices, and reproduce the input multiset."""
+    from sparkrdma_tpu.hbm.host_staging import read_array
+
+    def key_of(row):
+        k = int(row[0])
+        for i in range(1, kw):
+            k = (k << 32) | int(row[i])
+        return k
+
+    all_rows = []
+    prev_dev_max = None
+    for d in range(mesh):
+        runs = []
+        for path, k in run_paths:
+            if f"dev{d}.bin" not in os.path.basename(path):
+                continue
+            rows = read_array(path, np.uint32, (k, w))
+            keys = rows[:, 0].astype(np.uint64)
+            for i in range(1, kw):
+                keys = (keys << np.uint64(32)) | rows[:, i]
+            if np.any(keys[1:] < keys[:-1]):
+                return False                      # run not sorted
+            runs.append((keys, rows))
+        # the merge of sorted runs is sorted by construction (heapq.merge
+        # is the host-side ExternalSorter merge); what remains to prove
+        # globally is that device key ranges ascend
+        merged_keys = list(heapq.merge(*[k.tolist() for k, _ in runs]))
+        if merged_keys:
+            if prev_dev_max is not None and merged_keys[0] < prev_dev_max:
+                return False                      # device boundary broken
+            prev_dev_max = merged_keys[-1]
+        all_rows.extend(r for _, rows in runs for r in rows)
+    got = (np.stack(all_rows) if all_rows
+           else np.zeros((0, w), np.uint32))
+    ref = np.concatenate(
+        [source.chunk(j).T for j in range(len(source))])
+    if got.shape != ref.shape:
+        return False
+
+    def canon(a):
+        return a[np.lexsort(tuple(a[:, c]
+                                  for c in range(a.shape[1] - 1, -1, -1)))]
+    return bool(np.array_equal(canon(got), canon(ref)))
+
+
+__all__ = ["run_streaming_terasort", "StreamingSortResult"]
