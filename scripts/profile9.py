@@ -1,0 +1,139 @@
+"""The width-scaling experiment: does monolithic lax.sort cost scale
+with record WIDTH or record COUNT?
+
+Round-3/4 measurements suggest per-element overhead dominates:
+  W=4  @16M: 82 ms   (merge_sort.py status note)
+  W=8  @16M: 123 ms  (profile8 case a)
+=> cost ~ stages * N * (a + b*W) with a ~ 12*b. If that extrapolation
+holds, a 25-operand (100-byte-record) monolithic sort runs in ~190 ms =
+~8 GB/s at 1.6 GB/chip — the whole wide-record problem reduces to its
+COMPILE time (measured ~14 min), which a persistent compilation cache
+kills. This script measures W in {4, 13, 25} run cost and validates the
+cache (PROF_CACHE_DIR set -> jax.config compilation cache on).
+
+Cases (PROF_CASE): w4, w13, w25, w25pack (u64-packed operands).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cache_dir = os.environ.get("PROF_CACHE_DIR")
+
+import jax
+
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+
+
+def perturb(c):
+    return c ^ (c << 13) ^ (c >> 7)
+
+
+def time_op(name, fn, x, ks=None, bytes_moved=None):
+    if ks is None:
+        # PROF_KS=1: single-program timing (includes ~13ms dispatch) for
+        # cases whose k=3 chain would TRIPLE a minutes-long compile
+        ks = ((1,) if os.environ.get("PROF_KS") == "1" else (1, 3))
+
+    def chained(k):
+        def f(x):
+            for i in range(k):
+                x = fn(perturb(x) if i > 0 else x)
+            return x
+        return jax.jit(f)
+
+    times = []
+    t0 = time.perf_counter()
+    for k in ks:
+        g = chained(k)
+        out = g(x)
+        barrier(out)
+        if k == ks[0]:
+            compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0_ = time.perf_counter()
+            out = g(x)
+            barrier(out)
+            ts.append(time.perf_counter() - t0_)
+        times.append(min(ts))
+    slope = ((times[-1] - times[0]) / (ks[-1] - ks[0])
+             if len(ks) > 1 else times[0])
+    msg = f"{name:44s} per-op {slope*1e3:8.2f} ms"
+    if bytes_moved:
+        msg += f"  = {bytes_moved / slope / 1e9:6.2f} GB/s one-pass"
+    msg += f"   (compile+first {compile_s:.1f}s)"
+    print(msg, flush=True)
+    return slope
+
+
+def mono_sort(w):
+    def f(c):
+        out = lax.sort(tuple(c[i] for i in range(w)), num_keys=2,
+                       is_stable=False)
+        return jnp.stack(out)
+    return f
+
+
+def case_w(rng, w):
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(w, N), dtype=np.uint32))
+    barrier(cols)
+    time_op(f"monolithic sort W={w} (2-word key)", mono_sort(w), cols,
+            bytes_moved=N * 4 * w)
+
+
+def case_w25pack(rng):
+    """25 words as 1 u64 key + 11 u64 + 1 u32 value operands — fewer
+    operands through the comparator if per-OPERAND overhead exists."""
+    jax.config.update("jax_enable_x64", True)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(25, N), dtype=np.uint32))
+    barrier(cols)
+
+    def packed_sort(c):
+        def pack(hi, lo):
+            return (hi.astype(jnp.uint64) << jnp.uint64(32)) | lo
+        key = pack(c[0], c[1])
+        vals = tuple(pack(c[2 + 2 * i], c[3 + 2 * i]) for i in range(11)) \
+            + (c[24],)
+        out = lax.sort((key,) + vals, num_keys=1, is_stable=False)
+        outs = [out[0] >> jnp.uint64(32), out[0] & jnp.uint64(0xFFFFFFFF)]
+        for v in out[1:-1]:
+            outs += [v >> jnp.uint64(32), v & jnp.uint64(0xFFFFFFFF)]
+        outs.append(out[-1].astype(jnp.uint64))
+        return jnp.stack([o.astype(jnp.uint32) for o in outs])
+
+    time_op("u64-packed sort W=25 (14 operands)", packed_sort, cols,
+            bytes_moved=N * 100)
+
+
+def main():
+    case = os.environ.get("PROF_CASE", "w13")
+    print(f"platform={jax.devices()[0].platform} N={N} case={case} "
+          f"cache={'on' if cache_dir else 'off'}", flush=True)
+    rng = np.random.default_rng(0)
+    if case.startswith("w25pack"):
+        case_w25pack(rng)
+    elif case.startswith("w"):
+        case_w(rng, int(case[1:]))
+    else:
+        raise SystemExit(f"unknown case {case}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
