@@ -657,7 +657,8 @@ class ShuffleManager:
             def local_agg(cols, total):
                 valid = jnp.arange(cap) < total[0]
                 combined, nuniq = combine_by_key_cols(
-                    cols, valid, key_words, op, float_payload, wide=wide)
+                    cols, valid, key_words, op, float_payload, wide=wide,
+                    ride_words=self.conf.wide_sort_ride_words)
                 return combined, nuniq[None]
 
             fn = jax.jit(shard_map(
@@ -697,7 +698,9 @@ class ShuffleManager:
                     return merge_sort_cols(cols, valid,
                                            run=self.conf.fast_sort_run)
                 if wide:
-                    return sort_wide_cols(cols, key_words, valid)
+                    return sort_wide_cols(
+                        cols, key_words, valid,
+                        ride_words=self.conf.wide_sort_ride_words)
                 return lexsort_cols(cols, key_words, valid)
 
             fn = jax.jit(shard_map(

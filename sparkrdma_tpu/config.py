@@ -131,6 +131,13 @@ class ShuffleConf:
     #: drops from ~14min (25-operand variadic sort, measured round 3)
     #: to seconds. 0 disables (always ride).
     wide_sort_min_payload: int = 8
+    #: payload words that RIDE the wide sort as value operands (the rest
+    #: are placed by one gather pass). Measured v5e crossover: riding is
+    #: cheap up to ~13 total operands (sort cost 202ms at 16M) and
+    #: sharply superlinear beyond (630ms at 25 operands), while the
+    #: gather leg costs ~2.8 GB/s effective — so ride as much as stays
+    #: under the knee. 10 payload words + 2 keys + index = 13 operands.
+    wide_sort_ride_words: int = 10
 
     # --- observability ---
     collect_shuffle_read_stats: bool = False
@@ -162,6 +169,8 @@ class ShuffleConf:
             raise ValueError("hierarchy_hosts must be >= 0")
         if self.wide_sort_min_payload < 0:
             raise ValueError("wide_sort_min_payload must be >= 0")
+        if self.wide_sort_ride_words < 0:
+            raise ValueError("wide_sort_ride_words must be >= 0")
         if self.geometry_classes not in ("pow2", "fine"):
             raise ValueError(
                 f"unknown geometry_classes {self.geometry_classes!r}")

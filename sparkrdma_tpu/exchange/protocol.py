@@ -364,7 +364,7 @@ class ShuffleExchange:
             valid = jnp.arange(out_capacity) < total
             out, total = combine_by_key_cols(
                 out, valid, self.conf.key_words, aggregator, float_payload,
-                wide=wide)
+                wide=wide, ride_words=self.conf.wide_sort_ride_words)
         elif sort_key_words:
             from sparkrdma_tpu.kernels.merge_sort import merge_sort_cols
             from sparkrdma_tpu.kernels.sort import lexsort_cols
@@ -381,7 +381,8 @@ class ShuffleExchange:
                 out = merge_sort_cols(out, valid,
                                       run=self.conf.fast_sort_run)
             elif wide:
-                out = sort_wide_cols(out, sort_key_words, valid)
+                out = sort_wide_cols(out, sort_key_words, valid,
+                                     ride_words=self.conf.wide_sort_ride_words)
             else:
                 out = lexsort_cols(out, sort_key_words, valid)
         return out, total
@@ -444,9 +445,10 @@ class ShuffleExchange:
 
             # --- map side: bucket into per-partition runs -------------
             pids = partitioner(records).astype(jnp.int32)
-            sr, counts, offs = bucket_records(records, pids, num_parts,
-                                              wide=self._wide_sort(
-                                                  records.shape[0]))
+            sr, counts, offs = bucket_records(
+                records, pids, num_parts,
+                wide=self._wide_sort(records.shape[0]),
+                ride_words=self.conf.wide_sort_ride_words)
 
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
@@ -530,9 +532,10 @@ class ShuffleExchange:
 
         def local_prep(records):
             pids = partitioner(records).astype(jnp.int32)
-            sr, counts, offs = bucket_records(records, pids, num_parts,
-                                              wide=self._wide_sort(
-                                                  records.shape[0]))
+            sr, counts, offs = bucket_records(
+                records, pids, num_parts,
+                wide=self._wide_sort(records.shape[0]),
+                ride_words=self.conf.wide_sort_ride_words)
             dev_counts = _device_partition_counts(
                 counts, num_parts, mesh_size, ax)
             incoming = lax.all_to_all(

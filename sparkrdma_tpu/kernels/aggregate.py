@@ -66,6 +66,7 @@ def combine_by_key_cols(
     op: str = "sum",
     float_payload: bool = False,
     wide: bool = False,
+    ride_words: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Reduce payloads of equal keys; return ``(combined, num_unique)``.
 
@@ -80,7 +81,8 @@ def combine_by_key_cols(
     if wide:
         from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
-        srt = sort_wide_cols(cols, key_words, valid)
+        srt = sort_wide_cols(cols, key_words, valid,
+                             ride_words=ride_words)
     else:
         srt = lexsort_cols(cols, key_words, valid)
     nvalid = jnp.sum(valid).astype(jnp.int32)
@@ -114,14 +116,19 @@ def combine_by_key_cols(
     last_of_run = in_valid & ~next_same
     lead = (~last_of_run).astype(jnp.uint8)
     if wide:
-        # compact via a 2-operand (flag, index) sort + one gather pass
-        # instead of riding all W words through the network again
+        # compact via a (flag, ridden words..., index) sort + one gather
+        # pass instead of riding all W words through the network again
         from sparkrdma_tpu.kernels.wide_sort import apply_perm
 
-        idx = lax.iota(jnp.int32, n)
-        _, perm = lax.sort((lead, idx), num_keys=1, is_stable=True)
         full = jnp.concatenate([keys, red], axis=0)
-        out = apply_perm(full.T, perm).T
+        ride = max(0, min(ride_words, w))
+        idx = lax.iota(jnp.int32, n)
+        operands = (lead,) + tuple(full[i] for i in range(ride)) + (idx,)
+        packed = lax.sort(operands, num_keys=1, is_stable=True)
+        perm = packed[-1]
+        ridden = jnp.stack(packed[1:-1]) if ride else full[:0]
+        placed = apply_perm(full[ride:].T, perm).T
+        out = jnp.concatenate([ridden, placed], axis=0)
     else:
         operands = (lead,) + tuple(keys[i] for i in range(key_words)) \
             + tuple(red[i] for i in range(w - key_words))

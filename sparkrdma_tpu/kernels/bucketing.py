@@ -75,7 +75,7 @@ def histogram_pids(part_ids: jax.Array, num_parts: int,
 
 def bucket_records(
     records: jax.Array, part_ids: jax.Array, num_parts: int,
-    wide: bool = False
+    wide: bool = False, ride_words: int = 0
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Stable-sort a columnar batch ``[W, N]`` by destination partition.
 
@@ -105,10 +105,15 @@ def bucket_records(
     if wide:
         from sparkrdma_tpu.kernels.wide_sort import apply_perm
 
+        ride = max(0, min(ride_words, w))
         idx = lax.iota(jnp.int32, n)
-        sorted_ids, perm = lax.sort((part_ids, idx), num_keys=1,
-                                    is_stable=True)
-        bucketed = apply_perm(records.T, perm).T
+        operands = (part_ids,) + tuple(records[i] for i in range(ride)) \
+            + (idx,)
+        out = lax.sort(operands, num_keys=1, is_stable=True)
+        sorted_ids, perm = out[0], out[-1]
+        ridden = jnp.stack(out[1:-1]) if ride else records[:0]
+        placed = apply_perm(records[ride:].T, perm).T
+        bucketed = jnp.concatenate([ridden, placed], axis=0)
         counts = histogram_pids(part_ids, num_parts, sorted_ids=sorted_ids)
         offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32),

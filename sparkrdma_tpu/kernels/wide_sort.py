@@ -84,23 +84,41 @@ def apply_perm(rows: jax.Array, perm: jax.Array,
 
 
 def sort_wide_cols(
-    cols: jax.Array, key_words: int, valid: Optional[jax.Array] = None
+    cols: jax.Array, key_words: int, valid: Optional[jax.Array] = None,
+    ride_words: int = 0
 ) -> jax.Array:
     """Sort ``cols: uint32[W, N]`` by its leading ``key_words`` rows
-    without riding the payload through the comparator network.
+    without riding the full payload through the comparator network.
+
+    ``ride_words`` payload words RIDE the sort as value operands; the
+    rest are placed by one gather pass. The split exists because the
+    two cost curves cross (v5e, 16M records): riding costs ~10-16ms per
+    word up to ~13 total operands then turns sharply superlinear
+    (13 operands: 202ms, 25: 630ms), while the gather pass is
+    expensive but one-shot. The caller picks the measured optimum
+    (``ShuffleConf.wide_sort_ride_words``).
 
     Drop-in for :func:`~sparkrdma_tpu.kernels.sort.lexsort_cols` (same
     contract: stable, padding to the tail) for wide records.
     """
     w, n = cols.shape
-    sorted_keys, perm = sort_perm(cols, key_words, valid)
-    payload = cols[key_words:]                     # [W-kw, N]
-    # gather along the RECORD axis: rows-major [N, W-kw] is the layout
-    # the TPU gather engine moves efficiently (each index fetches one
-    # contiguous record slice); the transposes are plain streaming
-    # passes that XLA fuses around the gather
+    ride = max(0, min(ride_words, w - key_words))
+    idx = lax.iota(jnp.int32, n)
+    lead = () if valid is None else ((~valid).astype(jnp.uint8),)
+    operands = lead + tuple(cols[i] for i in range(key_words + ride)) \
+        + (idx,)
+    out = lax.sort(operands, num_keys=len(lead) + key_words,
+                   is_stable=True)
+    ridden = jnp.stack(out[len(lead):-1])          # keys + ridden payload
+    perm = out[-1]
+    if ride == w - key_words:
+        return ridden
+    payload = cols[key_words + ride:]              # [W-kw-ride, N]
+    # gather along the RECORD axis: rows-major [N, *] so each index
+    # fetches one contiguous record slice; the transposes are plain
+    # streaming passes that XLA fuses around the gather
     placed = apply_perm(payload.T, perm).T
-    return jnp.concatenate([sorted_keys, placed], axis=0)
+    return jnp.concatenate([ridden, placed], axis=0)
 
 
 __all__ = ["sort_wide_cols", "sort_perm", "apply_perm"]

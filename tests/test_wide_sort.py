@@ -139,3 +139,33 @@ def test_repartition_wide_records(rng):
         np.testing.assert_array_equal(canon(got), canon(x))
     finally:
         m.stop()
+
+
+@pytest.mark.parametrize("ride", [0, 3, 23, 99])
+def test_ride_words_parity(rng, ride):
+    """Every ride split (none / partial / all / clamped) produces the
+    identical sorted result."""
+    n = 1024
+    cols = jnp.asarray(rng.integers(0, 2**32, size=(25, n),
+                                    dtype=np.uint32))
+    nvalid = 900
+    valid = jnp.arange(n) < nvalid
+    ref = np.asarray(lexsort_cols(cols, 2, valid))
+    got = np.asarray(sort_wide_cols(cols, 2, valid, ride_words=ride))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_combine_wide_ride_parity(rng):
+    from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
+
+    n = 1024
+    cols = np.zeros((12, n), dtype=np.uint32)
+    cols[1] = rng.integers(0, 30, size=n)
+    cols[2:] = rng.integers(0, 1000, size=(10, n))
+    valid = np.ones(n, bool)
+    ref, nref = combine_by_key_cols(jnp.asarray(cols), jnp.asarray(valid),
+                                    2, "sum")
+    got, ngot = combine_by_key_cols(jnp.asarray(cols), jnp.asarray(valid),
+                                    2, "sum", wide=True, ride_words=4)
+    assert int(nref) == int(ngot)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
