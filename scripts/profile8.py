@@ -121,17 +121,17 @@ def case_take_rows(rng, n_chunks, width=23):
             bytes_moved=N * width * 4 * 2)
 
 
-def case_take_cols(rng):
+def case_take_cols(rng, width=23):
     perm_d = jax.device_put(rng.permutation(N).astype(np.int32))
     pay_cols = jax.device_put(
-        rng.integers(0, 2**32, size=(23, N), dtype=np.uint32))
+        rng.integers(0, 2**32, size=(width, N), dtype=np.uint32))
     barrier(pay_cols)
 
     def take_cols(cols, p):
         return jnp.take(cols, p, axis=1)
 
-    time_op("d. take [23, N] cols by perm axis=1", take_cols,
-            pay_cols, perm_d, bytes_moved=N * 92 * 2)
+    time_op(f"d. take [{width}, N] cols by perm axis=1", take_cols,
+            pay_cols, perm_d, bytes_moved=N * width * 4 * 2)
 
 
 def case_chunk_sort(rng, T):
@@ -176,8 +176,9 @@ def main():
         parts = case.split(":")
         case_take_rows(rng, int(parts[1]),
                        width=int(parts[2]) if len(parts) > 2 else 23)
-    elif case == "take_cols":
-        case_take_cols(rng)
+    elif case.startswith("take_cols"):
+        parts = case.split(":")
+        case_take_cols(rng, width=int(parts[1]) if len(parts) > 1 else 23)
     elif case.startswith("chunk_sort"):
         case_chunk_sort(rng, int(case.split(":")[1]))
     elif case == "floor":

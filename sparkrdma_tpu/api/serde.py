@@ -1,0 +1,99 @@
+"""Variable-length payload serialization — Spark's byte-stream records
+on a fixed-shape fabric.
+
+Spark shuffles SERIALIZED OBJECTS: the map side writes a byte stream per
+record (Kryo/Java serialization), the reduce side deserializes
+(SURVEY.md §3.3 "next(): take stream -> decompress -> deserialize").
+This framework's exchange moves fixed-width uint32 word records — the
+XLA-legal shape — so variable-length payloads need an encoding layer,
+exactly as the reference needs one between JVM objects and NIC bytes.
+
+The encoding is the PADDED SLOT scheme (the fixed-shape analogue of
+Kryo's bounded serialization buffers): a record is
+
+    [key words | length word (bytes) | payload words, zero-padded]
+
+with the payload slot sized to ``max_payload_bytes`` rounded up to whole
+words. Padding costs space for high-variance payloads — the same
+tradeoff the reference's ``maxAggBlock``-sized registered buffers make
+for small blocks — and oversized payloads are rejected loudly (Spark's
+serializer raises on buffer overflow the same way; raise the bound or
+split the payload upstream).
+
+Encoded batches are ordinary record batches: every exchange feature
+(partitioning, streaming rounds, fused key-ordering sort, checkpoints)
+applies unchanged; only the payload INTERPRETATION is byte-level.
+Little-endian byte order within words, fixed by the codec (not host
+order), so encoded batches checkpoint/restore portably.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def payload_words(max_payload_bytes: int) -> int:
+    """Words one payload slot occupies: 1 length word + ceil(bytes/4)."""
+    if max_payload_bytes < 0:
+        raise ValueError("max_payload_bytes must be >= 0")
+    return 1 + (max_payload_bytes + 3) // 4
+
+
+def encode_bytes_rows(
+    keys: np.ndarray, payloads: Sequence[bytes], max_payload_bytes: int
+) -> np.ndarray:
+    """Encode ``(key words, bytes payload)`` pairs into record rows.
+
+    ``keys: uint32[N, key_words]``; returns ``uint32[N, key_words + 1 +
+    ceil(max_payload_bytes/4)]`` rows ready for
+    ``MeshRuntime.shard_records`` / ``Dataset.from_host_rows``.
+    """
+    keys = np.asarray(keys, dtype=np.uint32)
+    n, kw = keys.shape
+    if len(payloads) != n:
+        raise ValueError(f"{n} keys but {len(payloads)} payloads")
+    slot_words = payload_words(max_payload_bytes) - 1
+    out = np.zeros((n, kw + 1 + slot_words), dtype=np.uint32)
+    out[:, :kw] = keys
+    buf = np.zeros((n, slot_words * 4), dtype=np.uint8)
+    for i, p in enumerate(payloads):
+        if len(p) > max_payload_bytes:
+            raise ValueError(
+                f"payload {i} is {len(p)} bytes > max_payload_bytes "
+                f"{max_payload_bytes} (raise the bound or split the "
+                "payload — the serializer will not truncate silently)")
+        out[i, kw] = len(p)
+        buf[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+    if slot_words:
+        out[:, kw + 1:] = buf.view("<u4")
+    return out
+
+
+def decode_bytes_rows(
+    rows: np.ndarray, key_words: int
+) -> Tuple[np.ndarray, List[bytes]]:
+    """Inverse of :func:`encode_bytes_rows` for any row batch (e.g. the
+    valid rows of an exchange output): returns ``(keys, payloads)``."""
+    rows = np.asarray(rows, dtype=np.uint32)
+    n, w = rows.shape
+    keys = rows[:, :key_words]
+    lens = rows[:, key_words]
+    slot_words = w - key_words - 1
+    blob = np.ascontiguousarray(
+        rows[:, key_words + 1:].astype("<u4")).view(np.uint8).reshape(
+            n, slot_words * 4)
+    max_bytes = slot_words * 4
+    payloads = []
+    for i in range(n):
+        ln = int(lens[i])
+        if ln > max_bytes:
+            raise ValueError(
+                f"row {i} declares {ln} payload bytes but the slot holds "
+                f"{max_bytes} — corrupt length word")
+        payloads.append(blob[i, :ln].tobytes())
+    return keys, payloads
+
+
+__all__ = ["encode_bytes_rows", "decode_bytes_rows", "payload_words"]

@@ -701,7 +701,8 @@ class ShuffleManager:
                     return sort_wide_cols(
                         cols, key_words, valid,
                         ride_words=self.conf.wide_sort_ride_words)
-                return lexsort_cols(cols, key_words, valid)
+                return lexsort_cols(cols, key_words, valid,
+                                    stable=False)
 
             fn = jax.jit(shard_map(
                 local_sort, mesh=self.runtime.mesh,

@@ -123,20 +123,21 @@ class ShuffleConf:
     fast_sort_run: int = 1 << 15
 
     #: payload width (in uint32 words) at or above which key-ordering
-    #: sorts use the WIDE-RECORD path: a 3-4 operand (keys, index) sort
-    #: plus one gather pass placing the payload, instead of riding every
-    #: payload word through lax.sort's O(log^2 N) comparator network.
-    #: Two separate wins at HiBench-faithful 100B records (23 payload
-    #: words): the comparator moves ~8x less data, and compile time
-    #: drops from ~14min (25-operand variadic sort, measured round 3)
-    #: to seconds. 0 disables (always ride).
-    wide_sort_min_payload: int = 8
+    #: sorts use the WIDE-RECORD path: ride ``wide_sort_ride_words``
+    #: payload words through the sort, place the rest with one gather
+    #: pass. Measured v5e crossover (16M records): monolithic variadic
+    #: sort costs ~15.3ms/word up to ~13 operands then turns superlinear
+    #: (13 ops: 202ms, 25 ops: 630ms); a gather pass costs 143ms fixed
+    #: + 15.3ms/word. Riding everything therefore WINS until the
+    #: superlinear zone eats the gather's fixed cost — at ~22 total
+    #: operands — so the default switches at 20 payload words. The wide
+    #: path also caps compile time (a 25-operand variadic sort compiles
+    #: for ~6-14 min over the tunnel vs seconds for 13 operands; the
+    #: persistent compilation cache amortizes either). 0 disables.
+    wide_sort_min_payload: int = 20
     #: payload words that RIDE the wide sort as value operands (the rest
-    #: are placed by one gather pass). Measured v5e crossover: riding is
-    #: cheap up to ~13 total operands (sort cost 202ms at 16M) and
-    #: sharply superlinear beyond (630ms at 25 operands), while the
-    #: gather leg costs ~2.8 GB/s effective — so ride as much as stays
-    #: under the knee. 10 payload words + 2 keys + index = 13 operands.
+    #: are placed by one gather pass): 10 + 2 keys + index = 13
+    #: operands, the measured knee of the sort-cost curve.
     wide_sort_ride_words: int = 10
 
     # --- observability ---

@@ -384,7 +384,11 @@ class ShuffleExchange:
                 out = sort_wide_cols(out, sort_key_words, valid,
                                      ride_words=self.conf.wide_sort_ride_words)
             else:
-                out = lexsort_cols(out, sort_key_words, valid)
+                # key-ordering only: Spark's sortByKey promises no
+                # secondary order, so the cheaper unstable network is
+                # contract-accurate here
+                out = lexsort_cols(out, sort_key_words, valid,
+                                   stable=False)
         return out, total
 
     def _wide_sort(self, record_words: int) -> bool:
