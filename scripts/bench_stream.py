@@ -15,6 +15,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def run(records_per_device: int, record_words: int, rounds: int,
         queue_depth: int, streaming: bool):
