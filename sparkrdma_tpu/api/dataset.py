@@ -388,7 +388,9 @@ class Dataset:
                 f"join overflow: a device matched {int(totals.max())} "
                 f"rows > out_capacity {out_capacity}; pass a larger "
                 "out_capacity (or None to auto-size)")
-        return jnp.array(joined), totals
+        # fn's output is a fresh compiled-program result (not a pooled
+        # exchange buffer), so no detach copy is needed
+        return joined, totals
 
     @staticmethod
     def collect_rows(cols: jax.Array, totals: np.ndarray) -> np.ndarray:

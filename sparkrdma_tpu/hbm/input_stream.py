@@ -64,6 +64,10 @@ class FileChunkSource:
         self._native = use_native
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
         self._next: Optional[Tuple[int, concurrent.futures.Future]] = None
+        # one-entry result cache: callers legitimately read a chunk
+        # twice (e.g. the splitter bootstrap samples chunk 0, then the
+        # stream loop feeds it) — the second read must not hit disk
+        self._last: Optional[Tuple[int, np.ndarray]] = None
 
     def __len__(self) -> int:
         return len(self._paths)
@@ -73,13 +77,19 @@ class FileChunkSource:
                           use_native=self._native)
 
     def chunk(self, j: int) -> np.ndarray:
+        if self._last is not None and self._last[0] == j:
+            return self._last[1]
         fut = None
         if self._next is not None and self._next[0] == j:
             fut = self._next[1]
             self._next = None
         arr = fut.result() if fut is not None else self._read(j)
-        if j + 1 < len(self._paths):   # prefetch the next file read
+        if j + 1 < len(self._paths) and (self._next is None
+                                         or self._next[0] != j + 1):
+            # prefetch the next file read (keep an in-flight prefetch
+            # for j+1 rather than resubmitting it)
             self._next = (j + 1, self._pool.submit(self._read, j + 1))
+        self._last = (j, arr)
         return arr
 
     def close(self) -> None:

@@ -26,7 +26,8 @@ regardless of key value (validity is the leading sort key).
 
 The reduce side uses this in place of ``lexsort_cols`` when the payload
 is wide enough that riding it through the network loses to one gather
-pass (see ``ShuffleConf.wide_sort_payload_words``).
+pass (see ``ShuffleConf.wide_sort_min_payload`` and
+``wide_sort_ride_words``).
 """
 
 from __future__ import annotations
@@ -66,21 +67,29 @@ def sort_perm(
 def apply_perm(rows: jax.Array, perm: jax.Array,
                chunk: int = _TAKE_CHUNK) -> jax.Array:
     """Permute ``rows`` (any array indexed on axis 0) by ``perm`` via
-    chunked takes: ``out[j] = rows[perm[j]]``."""
+    chunked takes: ``out[j] = rows[perm[j]]``.
+
+    A non-multiple length is padded up to whole chunks (index 0 fills;
+    the surplus rows are sliced off) — NEVER a single flat take, which
+    at ~16M rows is the exact op that aborts the TPU compiler (see
+    module docstring).
+    """
     n = perm.shape[0]
     if n <= chunk:
-        return jnp.take(rows, perm, axis=0, indices_are_sorted=False,
-                        unique_indices=True)
+        return jnp.take(rows, perm, axis=0)
     if n % chunk:
-        # geometry classes keep exchange capacities multiples of large
-        # powers of two well above this; fall back rather than mis-slice
-        return jnp.take(rows, perm, axis=0, unique_indices=True)
+        pad = chunk - n % chunk
+        perm = jnp.concatenate([perm, jnp.zeros((pad,), perm.dtype)])
+    m = perm.shape[0]
+    # plain takes (no unique_indices hint): the padded tail duplicates
+    # index 0, and the measured gather numbers were taken without the
+    # hint anyway
     outs = [
         jnp.take(rows, lax.dynamic_slice_in_dim(perm, i * chunk, chunk),
-                 axis=0, unique_indices=True)
-        for i in range(n // chunk)
+                 axis=0)
+        for i in range(m // chunk)
     ]
-    return jnp.concatenate(outs, axis=0)
+    return jnp.concatenate(outs, axis=0)[:n]
 
 
 def sort_wide_cols(

@@ -39,7 +39,7 @@ from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import range_partitioner
 from sparkrdma_tpu.hbm.host_staging import SpillWriter
 from sparkrdma_tpu.hbm.input_stream import InputStreamer
-from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+from sparkrdma_tpu.meta.sampling import compute_splitters
 from sparkrdma_tpu.utils.stats import barrier
 
 
@@ -51,6 +51,10 @@ class StreamingSortResult:
     stream_s: float
     verified: Optional[bool]
     run_paths: Sequence[str] = ()
+    #: no-spill mode: [1 + W] uint32 — total record count then per-word
+    #: sums (mod 2^32) folded across ALL chunks on device; compare
+    #: against the host dataset for a conservation proof
+    fold_sums: Optional[np.ndarray] = None
 
     @property
     def total_bytes(self) -> int:
@@ -90,14 +94,22 @@ def run_streaming_terasort(
     if n_chunks == 0:
         raise ValueError("empty chunk source")
 
-    # splitters from the FIRST chunk's on-fabric sample; identical for
-    # every chunk, so per-device key ranges are stable across the stream
-    first = next(iter(InputStreamer(rt, source)))
-    sampler = make_sampler(rt.mesh, rt.axis_name, kw, samples_per_device)
-    splitters = compute_splitters(
-        np.asarray(jax.device_get(sampler(first))), mesh)
+    # splitters from a random HOST sample of the first chunk (same
+    # with-replacement statistics as meta/sampling.make_sampler);
+    # identical for every chunk, so per-device key ranges are stable
+    # across the stream. Sampling host-side avoids spinning up a
+    # throwaway device streamer (which would burn two chunks of H2D and
+    # desync the file source's prefetch — review finding);
+    # FileChunkSource caches the chunk so the main loop's chunk(0) is a
+    # hit, not a re-read.
+    first_host = source.chunk(0)                   # [W, C]
+    n_samples = mesh * samples_per_device
+    idx = np.random.default_rng(0).integers(
+        0, first_host.shape[1], size=n_samples)
+    samples = np.ascontiguousarray(first_host[:kw, idx].T)
+    splitters = compute_splitters(samples, mesh)
     part = range_partitioner(splitters, kw)
-    del first
+    del first_host
 
     spiller = SpillWriter(use_native=manager.conf.use_native_staging) \
         if spill_dir else None
@@ -153,6 +165,7 @@ def run_streaming_terasort(
         chunks=n_chunks, records=records, record_bytes=4 * (w or 0),
         stream_s=stream_s, verified=verified,
         run_paths=tuple(p for p, _ in run_paths),
+        fold_sums=(None if acc is None else np.asarray(acc)),
     )
 
 
@@ -172,12 +185,6 @@ def _verify_runs(source, run_paths, mesh, kw, w) -> bool:
     """Host-side external-merge proof (test scale): device streams are
     sorted, ascend across devices, and reproduce the input multiset."""
     from sparkrdma_tpu.hbm.host_staging import read_array
-
-    def key_of(row):
-        k = int(row[0])
-        for i in range(1, kw):
-            k = (k << 32) | int(row[i])
-        return k
 
     all_rows = []
     prev_dev_max = None

@@ -75,15 +75,20 @@ def test_streaming_terasort_spill_runs(manager, tmp_path, rng):
 
 
 def test_streaming_terasort_fold_mode(manager, rng):
-    """No-spill mode: conservation sums across all chunks match host."""
-    import jax.numpy as jnp  # noqa: F401
-
+    """No-spill mode: the device fold accumulator (count + per-word
+    sums across ALL chunks) must equal the host dataset's — a real
+    conservation proof, not just bookkeeping counts."""
     cols = make_cols(rng, 4, 8 * 32 * 4)
     src = ArrayChunkSource(cols, 8 * 32)
     res = run_streaming_terasort(manager, src)
     assert res.chunks == 4
     assert res.verified is None
     assert res.records == cols.shape[1]
+    assert res.fold_sums is not None
+    ref = np.concatenate(
+        [[np.uint32(cols.shape[1])],
+         cols.sum(axis=1, dtype=np.uint32)]).astype(np.uint32)
+    np.testing.assert_array_equal(res.fold_sums, ref)
 
 
 def test_streaming_from_files_end_to_end(manager, tmp_path, rng):
