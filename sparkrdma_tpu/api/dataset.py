@@ -319,6 +319,76 @@ class Dataset:
         return self._exchange(part, num_parts, aggregator=op,
                               float_payload=float_payload)
 
+    def distinct(self) -> "Dataset":
+        """Unique FULL rows (rdd.distinct): duplicates are co-located by
+        a full-row hash exchange, then each device deduplicates its
+        rows with the combine-by-key machinery keyed on every word."""
+        m = self.manager
+        w = m.conf.record_words
+        kw = m.conf.key_words
+        num_parts = m.runtime.num_partitions
+
+        def full_row_hash(records):
+            h = jnp.uint32(0x9E3779B9)
+            for i in range(w):
+                h = (h ^ records[i]) * jnp.uint32(0x85EBCA6B)
+                h = (h << 13) | (h >> 19)
+            return (h % jnp.uint32(num_parts)).astype(jnp.int32)
+
+        full_row_hash.cache_key = ("fullhash", num_parts, w)
+        a = self._exchange(full_row_hash, num_parts)
+        cap = a.records.shape[1] // num_parts
+
+        cache = _join_programs.setdefault(m, {})
+        ck = ("distinct", cap, w)
+        fn = cache.get(ck)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
+            from sparkrdma_tpu.utils.compat import shard_map
+
+            rt = m.runtime
+            ax = rt.axis_name
+            null = jnp.uint32(_NULL)
+
+            def local(r, t):
+                filler = r[0] == null
+                for k in range(1, kw):
+                    filler = filler & (r[k] == null)
+                valid = (jnp.arange(cap) < t[0]) & ~filler
+                # dedupe = combine keyed on EVERY word (payload empty)
+                out, nuniq = combine_by_key_cols(r, valid, w)
+                return out, nuniq[None]
+
+            fn = jax.jit(shard_map(
+                local, mesh=rt.mesh,
+                in_specs=(P(None, ax), P(ax)),
+                out_specs=(P(None, ax), P(ax)),
+            ))
+            cache[ck] = fn
+        out, totals = fn(a.records, a.totals)
+        return Dataset(m, out, jnp.array(totals))
+
+    def count_by_key(self) -> "Dataset":
+        """Per-key record counts (rdd.countByKey): rows become
+        ``(key words, count, 0...)`` with counts in the first payload
+        word, combined across the mesh by the fused aggregator."""
+        m = self.manager
+        if m.conf.val_words < 1:
+            raise ValueError("count_by_key needs at least one payload "
+                             "word to hold the count")
+        kw = m.conf.key_words
+        w = m.conf.record_words
+
+        def to_ones(records):
+            ones = jnp.ones((1, records.shape[1]), jnp.uint32)
+            zeros = jnp.zeros((w - kw - 1, records.shape[1]), jnp.uint32)
+            return jnp.concatenate([records[:kw], ones, zeros], axis=0)
+
+        counted = Dataset(m, jax.jit(to_ones)(self.records), self.totals)
+        return counted.reduce_by_key("sum")
+
     def join_count(self, other: "Dataset") -> Tuple[int, float]:
         """Inner-join cardinality + sum of payload products against
         ``other`` on the LOW key word (the TPC-DS-style aggregate join;

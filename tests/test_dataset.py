@@ -273,3 +273,37 @@ def test_join_zero_matches(manager, rng):
         Dataset.from_host_rows(manager, xb))
     assert totals.sum() == 0
     assert not np.any(np.asarray(joined))
+
+
+def test_distinct_removes_duplicates(manager, rng):
+    n = 8 * 32
+    base = rng.integers(1, 2**31, size=(n // 4, 4), dtype=np.uint32)
+    x = np.concatenate([base, base, base, base])   # every row x4
+    rng.shuffle(x)
+    ds = Dataset.from_host_rows(manager, x).distinct()
+    got = ds.to_host_rows()
+    np.testing.assert_array_equal(canon(got), canon(np.unique(base, axis=0)))
+
+
+def test_distinct_after_padded_chain(manager, rng):
+    """distinct on a Dataset carrying null-key filler (non-divisible
+    count) must not count the filler as a distinct row."""
+    x = rng.integers(1, 2**31, size=(8 * 16, 4), dtype=np.uint32)
+    x[1::2] = x[::2]                                # half duplicated
+    ds = Dataset.from_host_rows(manager, x).repartition()
+    got = ds.distinct().to_host_rows()
+    np.testing.assert_array_equal(canon(got), canon(np.unique(x, axis=0)))
+
+
+def test_count_by_key_matches_numpy(manager, rng):
+    n = 8 * 32
+    x = np.zeros((n, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, 9, size=n)
+    x[:, 2] = rng.integers(0, 2**32, size=n)       # payload ignored
+    ds = Dataset.from_host_rows(manager, x).count_by_key()
+    got = ds.to_host_rows()
+    ref = {}
+    for k in x[:, 1]:
+        ref[(0, int(k))] = ref.get((0, int(k)), 0) + 1
+    got_map = {(int(r[0]), int(r[1])): int(r[2]) for r in got}
+    assert got_map == ref
