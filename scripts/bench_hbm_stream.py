@@ -11,6 +11,13 @@ Env: BENCH_CHUNK_RECORDS (default 8M), BENCH_CHUNKS (default 8),
 BENCH_RECORD_WORDS (default 13), BENCH_SPILL_DIR (default off),
 BENCH_TRACE_DIR (default off: jax.profiler trace of two mid-stream
 chunks, proving the H2D/compute overlap).
+
+DEPLOYMENT CAVEAT (measured round 4): over the axon tunnel the chip is
+network-attached and host→device runs at ~12-16 MB/s (27-39s per 436MB
+device_put), so the sustained number here reads ~0.01 GB/s/chip even
+though the device-side legs run each chunk in ~120ms. On a real TPU
+host (PCIe H2D at 10-60 GB/s) the same pipeline is compute-bound; see
+README's round-4 notes.
 """
 
 import json
