@@ -1,0 +1,114 @@
+"""On-chip verification sweep: every BASELINE workload family runs its
+numpy-reference check on the real TPU (not just the CPU test mesh).
+
+Round-4 re-run: the aggregator was rewritten scatter-free and the
+wide-record paths landed since the round-3 sweep; this proves the
+families (BASELINE.md configs 1-5) still verify on hardware, plus the
+100-byte wide-record terasort end to end.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+
+def main() -> int:
+    print(f"platform={jax.devices()[0].platform}", flush=True)
+    rng = np.random.default_rng(4)
+    results = {}
+    t0 = time.perf_counter()
+
+    conf = ShuffleConf(slot_records=1 << 16)
+    m = ShuffleManager(MeshRuntime(conf), conf)
+    try:
+        from sparkrdma_tpu.workloads.join import run_hash_join
+        from sparkrdma_tpu.workloads.repartition import run_repartition
+        from sparkrdma_tpu.workloads.terasort import run_terasort
+        from sparkrdma_tpu.workloads.tpcds import (run_q64_shape,
+                                                   run_q95_shape)
+
+        r = run_repartition(m, records_per_device=1 << 15,
+                            num_parts=4 * m.runtime.num_partitions,
+                            shuffle_id=100)
+        results["repartition"] = r.verified
+        t, _, _ = run_terasort(m, records_per_device=1 << 15,
+                               shuffle_id=101)
+        results["terasort"] = t.verified
+        j = run_hash_join(m, rows_per_device_a=1 << 13,
+                          rows_per_device_b=1 << 13,
+                          shuffle_ids=(102, 103))
+        results["join"] = j.verified
+        q64 = run_q64_shape(m, fact_rows_per_device=1 << 12,
+                            shuffle_ids=(104, 105, 106, 110, 111))
+        results["tpcds_q64"] = q64.verified
+        q95 = run_q95_shape(m, sales_rows_per_device=1 << 12,
+                            return_rows_per_device=1 << 10,
+                            shuffle_ids=(107, 108))
+        results["tpcds_q95"] = q95.verified
+    finally:
+        m.stop()
+
+    from sparkrdma_tpu.workloads.als import run_als
+    from sparkrdma_tpu.workloads.pagerank import run_pagerank
+
+    conf2 = ShuffleConf(slot_records=1 << 14)
+    rt2 = MeshRuntime(conf2)
+    try:
+        v, e = 256, 2048
+        edges = np.stack([rng.integers(0, v, size=e),
+                          rng.integers(0, v, size=e)], axis=1)
+        pr = run_pagerank(rt2, edges, v, iterations=3)
+        results["pagerank"] = pr.verified
+
+        num_users, num_items, n, k = 64, 48, 1024, 4
+        u_true = rng.standard_normal((num_users, k))
+        v_true = rng.standard_normal((num_items, k))
+        pairs = rng.choice(num_users * num_items, size=n, replace=False)
+        uu, ii = pairs // num_items, pairs % num_items
+        rr = np.sum(u_true[uu] * v_true[ii], axis=1) \
+            + 0.01 * rng.standard_normal(n)
+        ratings = np.stack([uu, ii, rr], axis=1)
+        a = run_als(rt2, ratings, num_users, num_items, rank=k,
+                    iterations=2)
+        results["als"] = a.verified
+    finally:
+        rt2.stop()
+
+    # wide-record terasort on hardware (the 100B format end to end)
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    wconf = ShuffleConf(slot_records=1 << 15, val_words=23)
+    mw = ShuffleManager(MeshRuntime(wconf), wconf)
+    try:
+        t, _, _ = run_terasort(mw, records_per_device=1 << 14,
+                               shuffle_id=120)
+        results["terasort_100B"] = t.verified
+    finally:
+        mw.stop()
+
+    elapsed = time.perf_counter() - t0
+    ok = all(bool(vv) for vv in results.values())
+    for kk, vv in results.items():
+        print(f"{kk:16s} verified={vv}", flush=True)
+    print(f"{'ALL VERIFIED' if ok else 'FAILURES'} in {elapsed:.0f}s",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

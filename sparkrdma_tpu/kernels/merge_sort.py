@@ -29,7 +29,13 @@ in-VMEM bitonic merge network (reverse 17 + merge 17 passes over the
 the hardware's bitonic floor. The kernel therefore ships OPT-IN
 (``ShuffleConf(fast_sort=True)``), fully tested, as the scaffold for
 future tuning (fewer VMEM passes via Batcher merge without the reversal,
-key-only networks with rank-based payload placement).
+key-only networks with rank-based payload placement). Round 4's wider
+measurement campaign (README "sort floor" study) generalized this
+finding: EVERY comparator-expressible route — monolithic, batched
+quota sample-sort, key+index sort with gather placement, run-copy DMA
+partition kernels — converges on the same floor, because Mosaic
+exposes no vector scatter and the grouping step of any partition
+scheme is itself a comparator pass.
 
 Records compare lexicographically over ALL ``W`` words (keys lead, payload
 words break ties). Total order up to identical records makes every
