@@ -1,0 +1,81 @@
+"""Map-side cost at the round-4 width: multi-partition exchange on one
+chip at W=13, monolithic pid-sort bucketing vs the wide (ride/gather)
+bucket path — validates ShuffleConf.wide_sort_min_payload for the MAP
+side, where the pid sort carries all W words as values.
+
+Env: PROF_RECORDS (default 8M), PROF_PARTS (default 8 parts/device),
+PROF_WORDS (default 13), PROF_RIDE (default 10).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 8 * 1024 * 1024))
+PARTS = int(os.environ.get("PROF_PARTS", 8))
+W = int(os.environ.get("PROF_WORDS", 13))
+RIDE = int(os.environ.get("PROF_RIDE", 10))
+REPEATS = 8
+
+
+def run(min_payload: int) -> float:
+    conf = ShuffleConf(slot_records=1 << 22, max_slot_records=1 << 24,
+                       val_words=W - 2, geometry_classes="fine",
+                       wide_sort_min_payload=min_payload,
+                       wide_sort_ride_words=RIDE)
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    try:
+        mesh = manager.runtime.num_partitions
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 2**32, size=(mesh * N, W), dtype=np.uint32)
+        records = manager.runtime.shard_records(x)
+        part = hash_partitioner(PARTS * mesh, conf.key_words)
+        handle = manager.register_shuffle(1, PARTS * mesh, part)
+        try:
+            manager.get_writer(handle).write(records).stop(True)
+            reader = manager.get_reader(handle)
+            barrier(reader.read(record_stats=False)[0])
+            t0 = time.perf_counter()
+            for _ in range(REPEATS - 1):
+                reader.read(record_stats=False)
+            out, _ = reader.read()
+            barrier(out)
+            dt = (time.perf_counter() - t0) / REPEATS
+        finally:
+            manager.unregister_shuffle(1)
+    finally:
+        manager.stop()
+    mode = "wide" if W - 2 >= min_payload else "monolithic"
+    gbps = N * W * 4 / dt / 1e9
+    print(f"bucket={mode:10s} {dt*1e3:8.2f} ms/exchange = {gbps:6.2f} "
+          f"GB/s ({PARTS} parts/device, W={W})", flush=True)
+    return dt
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N}", flush=True)
+    mono = run(min_payload=20)     # payload 11 < 20 -> monolithic
+    wide = run(min_payload=4)      # payload 11 >= 4 -> wide bucket
+    print(f"wide/monolithic ratio: {wide / mono:.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
