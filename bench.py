@@ -30,7 +30,8 @@ superlinear beyond — while the alternative (sort keys+index, gather the
 payload) pays 143ms fixed + 15.3ms/word for the gather. GB/s over
 width is therefore a PEAKED curve:
 
-    16B: 2.6   32B: 3.2   52B: ~4.0   100B: ~2.9  GB/s/chip
+    16B: 2.6   32B: 3.2   48B: 3.60   52B: 3.74   64B: 3.64
+    100B: 2.69   (GB/s/chip, full pipeline, measured)
 
 The default is the measured optimum (52B). The HiBench-faithful 100B
 config (BENCH_RECORD_WORDS=25) is fully supported — the wide-record
