@@ -48,8 +48,9 @@ import sys
 
 
 def main() -> int:
-    # 16M x 32B = 512MB/chip: the log^2 sort amortizes better over
-    # larger batches (measured 2.27 vs 2.10 GB/s at 256MB of 16B recs)
+    # 16M records/chip (872MB at the default width): the log^2 sort
+    # amortizes better over larger batches, and 16M measured optimal in
+    # the round-4 batch sweep (8M/12M/24M all score lower GB/s)
     records_per_device = int(os.environ.get("BENCH_RECORDS_PER_DEVICE",
                                             16 * 1024 * 1024))
     repeats = int(os.environ.get("BENCH_REPEATS", 16))

@@ -71,8 +71,8 @@ def run(min_payload: int) -> float:
 
 def main():
     print(f"platform={jax.devices()[0].platform} N={N}", flush=True)
-    mono = run(min_payload=20)     # payload 11 < 20 -> monolithic
-    wide = run(min_payload=4)      # payload 11 >= 4 -> wide bucket
+    mono = run(min_payload=W)      # payload W-2 < W -> monolithic
+    wide = run(min_payload=4)      # payload >= 4 -> wide bucket
     print(f"wide/monolithic ratio: {wide / mono:.3f}", flush=True)
     return 0
 

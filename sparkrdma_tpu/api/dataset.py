@@ -381,12 +381,23 @@ class Dataset:
         kw = m.conf.key_words
         w = m.conf.record_words
 
-        def to_ones(records):
-            ones = jnp.ones((1, records.shape[1]), jnp.uint32)
-            zeros = jnp.zeros((w - kw - 1, records.shape[1]), jnp.uint32)
-            return jnp.concatenate([records[:kw], ones, zeros], axis=0)
+        cache = _join_programs.setdefault(m, {})
+        ck = ("count_ones", w, kw, self.records.shape)
+        to_ones = cache.get(ck)
+        if to_ones is None:
+            # cached per geometry: a fresh jit closure per call would
+            # retrace+recompile every invocation (same rationale as the
+            # join program cache above)
+            @jax.jit
+            def to_ones(records):
+                n = records.shape[1]
+                ones = jnp.ones((1, n), jnp.uint32)
+                zeros = jnp.zeros((w - kw - 1, n), jnp.uint32)
+                return jnp.concatenate([records[:kw], ones, zeros],
+                                       axis=0)
 
-        counted = Dataset(m, jax.jit(to_ones)(self.records), self.totals)
+            cache[ck] = to_ones
+        counted = Dataset(m, to_ones(self.records), self.totals)
         return counted.reduce_by_key("sum")
 
     def join_count(self, other: "Dataset") -> Tuple[int, float]:

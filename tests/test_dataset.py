@@ -286,13 +286,21 @@ def test_distinct_removes_duplicates(manager, rng):
 
 
 def test_distinct_after_padded_chain(manager, rng):
-    """distinct on a Dataset carrying null-key filler (non-divisible
-    count) must not count the filler as a distinct row."""
-    x = rng.integers(1, 2**31, size=(8 * 16, 4), dtype=np.uint32)
-    x[1::2] = x[::2]                                # half duplicated
-    ds = Dataset.from_host_rows(manager, x).repartition()
-    got = ds.distinct().to_host_rows()
-    np.testing.assert_array_equal(canon(got), canon(np.unique(x, axis=0)))
+    """distinct on a Dataset carrying null-key filler must not count the
+    filler as a distinct row. A first distinct() leaves a NON-mesh-
+    divisible unique count (101 here), so the chained verb re-densifies
+    WITH reserved-key filler rows — the case the filler mask exists for
+    (a mesh-divisible input would leave the mask untested)."""
+    uniq = 101                                      # not divisible by 8
+    base = rng.integers(1, 2**31, size=(uniq, 4), dtype=np.uint32)
+    base = np.unique(base, axis=0)
+    reps = (8 * 16) // base.shape[0] + 1
+    x = np.tile(base, (reps, 1))[:8 * 16]
+    ds1 = Dataset.from_host_rows(manager, x).distinct()
+    assert ds1.count == base.shape[0]
+    assert ds1.count % 8 != 0                       # forces filler next
+    got = ds1.distinct().to_host_rows()             # chained: filler path
+    np.testing.assert_array_equal(canon(got), canon(base))
 
 
 def test_count_by_key_matches_numpy(manager, rng):
