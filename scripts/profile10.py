@@ -5,6 +5,12 @@ side, where the pid sort carries all W words as values.
 
 Env: PROF_RECORDS (default 8M), PROF_PARTS (default 8 parts/device),
 PROF_WORDS (default 13), PROF_RIDE (default 10).
+
+Measured (round 4): W=13 monolithic 163.5ms vs wide 241.3ms per
+exchange (1.48x) -> monolithic wins below the threshold. At W=25 the
+monolithic leg's 26-operand variadic sort exceeded a 40-minute compile
+timeout at 4M records — the wide path is forced at that width by
+compile time before runtime even enters the comparison.
 """
 
 import os
