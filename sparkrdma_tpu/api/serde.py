@@ -57,17 +57,27 @@ def encode_bytes_rows(
     slot_words = payload_words(max_payload_bytes) - 1
     out = np.zeros((n, kw + 1 + slot_words), dtype=np.uint32)
     out[:, :kw] = keys
-    buf = np.zeros((n, slot_words * 4), dtype=np.uint8)
-    for i, p in enumerate(payloads):
-        if len(p) > max_payload_bytes:
-            raise ValueError(
-                f"payload {i} is {len(p)} bytes > max_payload_bytes "
-                f"{max_payload_bytes} (raise the bound or split the "
-                "payload — the serializer will not truncate silently)")
-        out[i, kw] = len(p)
-        buf[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
-    if slot_words:
-        out[:, kw + 1:] = buf.view("<u4")
+    # bulk encode (round 5 — the per-row frombuffer loop measured ~30x
+    # slower at bench scale): lengths in one fromiter pass, then ONE
+    # join of zero-ljust'ed payloads gives the padded byte layout
+    # directly (ljust is a single C call per row; measured 0.3s/1M
+    # records vs 5.6s for cumsum+repeat scatter indexing and 10s for
+    # the old per-row loop)
+    lens = np.fromiter((len(p) for p in payloads), dtype=np.int64,
+                       count=n) if n else np.zeros(0, np.int64)
+    if n and int(lens.max(initial=0)) > max_payload_bytes:
+        i = int(np.argmax(lens > max_payload_bytes))
+        raise ValueError(
+            f"payload {i} is {int(lens[i])} bytes > max_payload_bytes "
+            f"{max_payload_bytes} (raise the bound or split the "
+            "payload — the serializer will not truncate silently)")
+    out[:, kw] = lens.astype(np.uint32)
+    if slot_words and n:
+        slot_bytes = slot_words * 4
+        buf = np.frombuffer(
+            b"".join(p.ljust(slot_bytes, b"\0") for p in payloads),
+            dtype=np.uint8)
+        out[:, kw + 1:] = buf.view("<u4").reshape(n, slot_words)
     return out
 
 
@@ -81,18 +91,20 @@ def decode_bytes_rows(
     keys = rows[:, :key_words]
     lens = rows[:, key_words]
     slot_words = w - key_words - 1
-    blob = np.ascontiguousarray(
-        rows[:, key_words + 1:].astype("<u4")).view(np.uint8).reshape(
-            n, slot_words * 4)
     max_bytes = slot_words * 4
-    payloads = []
-    for i in range(n):
-        ln = int(lens[i])
-        if ln > max_bytes:
-            raise ValueError(
-                f"row {i} declares {ln} payload bytes but the slot holds "
-                f"{max_bytes} — corrupt length word")
-        payloads.append(blob[i, :ln].tobytes())
+    if n and int(lens.max(initial=0)) > max_bytes:
+        i = int(np.argmax(lens > max_bytes))
+        raise ValueError(
+            f"row {i} declares {int(lens[i])} payload bytes but the "
+            f"slot holds {max_bytes} — corrupt length word")
+    # bulk decode: ONE contiguous-bytes materialization of the whole
+    # blob, then per-row slicing of a Python bytes object (C-speed, no
+    # per-row numpy ops — round 5, same rationale as the encoder)
+    whole = np.ascontiguousarray(
+        rows[:, key_words + 1:].astype("<u4")).view(np.uint8).tobytes()
+    lens_l = lens.tolist()
+    payloads = [whole[i * max_bytes: i * max_bytes + ln]
+                for i, ln in enumerate(lens_l)]
     return keys, payloads
 
 

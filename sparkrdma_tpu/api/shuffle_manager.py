@@ -63,20 +63,32 @@ class ShuffleHandle:
     partitioner: Callable
 
 
-def _partition_window(plan: ShufflePlan, mesh: int,
-                      partition: int) -> Tuple[int, int, int]:
-    """Locate partition ``p`` inside the raw exchange output layout.
+def _partition_windows(plan: ShufflePlan, mesh: int, num_parts: int,
+                       partition: int) -> list:
+    """Locate ORIGINAL partition ``p`` inside the raw exchange output.
 
-    Returns ``(device, start_within_device, length)``. The output
-    stream on device ``d`` is its local partitions in ascending global
-    id, each a contiguous segment of ``sum(counts[:, p])`` records —
-    the single source of truth for this layout math (used by both
-    ``read_partition`` and ``OutputView.partition``).
+    Returns a list of ``(device, start_within_device, length)`` windows
+    — one per sub-partition when the plan was skew-split
+    (``split_factor`` sub-partitions ``p + num_parts*j``, all owned by
+    the SAME device as ``p``), a single window otherwise. The output
+    stream on device ``d`` is its local (sub-)partitions in ascending
+    global id, each a contiguous segment of ``sum(counts[:, sp])``
+    records — the single source of truth for this layout math (used by
+    ``read_partition``, ``OutputView.partition`` and the skew-split
+    range filter). The reference serves the same lookup from its
+    ``RdmaMapTaskOutput`` tables (RdmaMappedFile §getRdmaBlockLocation);
+    sub-partitions are this design's plan-time artifact, so they are
+    mapped back to their parent here, invisibly to readers.
     """
-    d, q = partition % mesh, partition // mesh
+    d = partition % mesh
     owned = plan.counts.sum(axis=0)
-    start = sum(int(owned[qq * mesh + d]) for qq in range(q))
-    return d, start, int(owned[partition])
+    windows = []
+    for j in range(plan.split_factor):
+        sp = partition + num_parts * j
+        q = sp // mesh
+        start = sum(int(owned[qq * mesh + d]) for qq in range(q))
+        windows.append((d, start, int(owned[sp])))
+    return windows
 
 
 class ShuffleWriter:
@@ -203,13 +215,6 @@ class ShuffleReader:
                 # a statement about exchange throughput.
                 filtered = (self.start_partition, self.end_partition) != (
                     0, self._h.num_parts)
-                if filtered and writer.plan.split_factor > 1:
-                    raise ValueError(
-                        "partition-range reads are not supported on a "
-                        "skew-split shuffle (records of one partition "
-                        "are spread over sub-partitions); read the full "
-                        "range or raise slot_records/max_rounds to avoid "
-                        "splitting")
                 # Full-range reads fuse sort/aggregation into the
                 # exchange program (one dispatch); a partition filter
                 # must apply first, so those stay separate programs there.
@@ -230,11 +235,23 @@ class ShuffleReader:
                             )
                         if filtered:
                             with annotate("shuffle:filter+agg+sort"):
-                                out, totals = self._m._filtered(
-                                    out, totals, writer.plan,
-                                    self._h.num_parts,
-                                    self.start_partition,
-                                    self.end_partition)
+                                if writer.plan.split_factor > 1:
+                                    # sub-partition segments of a parent
+                                    # are scattered through the stream;
+                                    # a rank-keyed compaction regroups
+                                    # them (no refusal mode — the
+                                    # reference serves any range)
+                                    out, totals = self._m._filtered_split(
+                                        out, totals, writer.plan,
+                                        self._h.num_parts,
+                                        self.start_partition,
+                                        self.end_partition)
+                                else:
+                                    out, totals = self._m._filtered(
+                                        out, totals, writer.plan,
+                                        self._h.num_parts,
+                                        self.start_partition,
+                                        self.end_partition)
                                 if self.aggregator:
                                     out, totals = self._m._aggregated(
                                         out, totals, writer.plan,
@@ -307,14 +324,11 @@ class ShuffleReader:
         Per-partition slicing needs the raw (local partition, source)
         layout, so the view always reads full-range and unsorted
         regardless of this reader's options (same rule and reason as
-        :meth:`read_partition`).
+        :meth:`read_partition`). On a skew-split plan a partition's
+        records span its sub-partitions' segments, so ``partition(p)``
+        concatenates them (a small device copy instead of a zero-copy
+        slice).
         """
-        plan = self._m._recover_writer(self._h).plan
-        if plan is not None and plan.split_factor > 1:
-            # check BEFORE dispatching the (large, skewed) full exchange
-            raise ValueError(
-                "partition views are not supported on a skew-split "
-                "shuffle (records of one partition span sub-partitions)")
         out, totals = ShuffleReader(self._m, self._h).read()
         plan = self._m._writers[self._h.shuffle_id].plan
         return OutputView(self._m, self._h, out, totals, plan)
@@ -330,22 +344,22 @@ class ShuffleReader:
                 f"partition {partition} outside reader range "
                 f"[{self.start_partition}, {self.end_partition})"
             )
-        pre_plan = self._m._recover_writer(self._h).plan
-        if pre_plan is not None and pre_plan.split_factor > 1:
-            # check BEFORE dispatching the (large, skewed) full exchange
-            raise ValueError(
-                "read_partition is not supported on a skew-split shuffle")
         # Segment offsets assume the raw full-range (local partition,
         # source) layout, so read full-range and unsorted even if this
         # reader filters/sorts — slices are cut from the raw layout via
-        # the shared _partition_window math.
+        # the shared _partition_windows math (which maps skew-split
+        # sub-partitions back to their parent).
         out, totals = ShuffleReader(self._m, self._h).read()
         mesh = self._m.runtime.num_partitions
         plan = self._m._writers[self._h.shuffle_id].plan
         cap = plan.out_capacity
-        d, start, length = _partition_window(plan, mesh, partition)
-        dev_cols = np.asarray(out)[:, d * cap:(d + 1) * cap]   # [W, cap]
-        return np.ascontiguousarray(dev_cols[:, start:start + length].T)
+        arr = np.asarray(out)      # ONE full D2H, windows slice from it
+        pieces = []
+        for d, start, length in _partition_windows(
+                plan, mesh, self._h.num_parts, partition):
+            dev_cols = arr[:, d * cap:(d + 1) * cap]
+            pieces.append(dev_cols[:, start:start + length].T)
+        return np.ascontiguousarray(np.concatenate(pieces, axis=0))
 
 
 class OutputView:
@@ -366,10 +380,6 @@ class OutputView:
                  out: jax.Array, totals: jax.Array, plan: ShufflePlan):
         from sparkrdma_tpu.hbm.slot_pool import Slot
 
-        if plan.split_factor > 1:
-            raise ValueError(
-                "partition views are not supported on a skew-split "
-                "shuffle (records of one partition span sub-partitions)")
         # detach: the raw output is recycled by the NEXT same-geometry
         # exchange; a refcounted view must own its pages
         self._arr = jnp.array(out)
@@ -399,12 +409,21 @@ class OutputView:
 
     def partition(self, p: int) -> jax.Array:
         """Columnar records of partition ``p`` (valid rows only — the
-        reference's per-block view granularity)."""
+        reference's per-block view granularity). On a skew-split plan
+        the partition's sub-partition segments are concatenated (a
+        small device copy; single-segment plans stay zero-copy
+        slices)."""
         if not 0 <= p < self._handle.num_parts:
             raise ValueError(f"partition {p} out of range")
-        d, start, length = _partition_window(self._plan, self._mesh, p)
-        start += d * self._cap
-        return lax.slice_in_dim(self._arr, start, start + length, axis=1)
+        slices = []
+        for d, start, length in _partition_windows(
+                self._plan, self._mesh, self._handle.num_parts, p):
+            s = start + d * self._cap
+            slices.append(lax.slice_in_dim(self._arr, s, s + length,
+                                           axis=1))
+        if len(slices) == 1:
+            return slices[0]
+        return jnp.concatenate(slices, axis=1)
 
 
 class ShuffleManager:
@@ -416,8 +435,11 @@ class ShuffleManager:
         self.runtime = runtime or MeshRuntime(conf)
         self.conf = conf or self.runtime.conf
         if store is None and self.conf.spill_dir:
-            store = MapOutputStore(self.conf.spill_dir,
-                                   use_native=self.conf.use_native_staging)
+            store = MapOutputStore(
+                self.conf.spill_dir,
+                use_native=self.conf.use_native_staging,
+                compression=self.conf.compression,
+                compression_level=self.conf.compression_level)
         self.store = store
         # the runtime's SlotPool serves exchange recv/output buffers
         # (RdmaBufferManager wiring: the node owns the pool, channels use it)
@@ -630,6 +652,79 @@ class ShuffleManager:
             self._filter_cache[key] = fn
         return fn(out, window)
 
+    def _filtered_split(self, out: jax.Array, totals: jax.Array,
+                        plan: ShufflePlan, num_parts: int,
+                        start: int, end: int) -> Tuple[jax.Array, jax.Array]:
+        """Partition-range filter for SKEW-SPLIT plans.
+
+        Under a split plan the records of original partition ``p`` are
+        scattered across ``split_factor`` sub-partition segments of the
+        device stream, so the kept set is not one contiguous window
+        (:meth:`_filtered`'s trick). Instead every segment gets a host-
+        computed RANK — ``(parent - start) * split + j`` for kept
+        segments, the all-ones sentinel for dropped ones — each row
+        inherits its segment's rank via one ``searchsorted`` against the
+        segment-boundary cumsum, and a single stable rank-keyed sort
+        compacts kept rows to the front GROUPED BY PARENT partition
+        (then sub-partition, then stream order): exactly the layout an
+        unsplit range read produces. Wide records route through the
+        (rank, index)-sort + one-gather path, so a W=25 filtered read
+        never meets the 25-operand compile wall. Rank/length tables are
+        device data, so ONE compiled program per geometry serves every
+        range.
+        """
+        mesh = self.runtime.num_partitions
+        cap = plan.out_capacity
+        k = plan.split_factor
+        owned = plan.counts.sum(axis=0)          # [num_parts * k]
+        s_total = (num_parts * k) // mesh        # segments per device
+        seg_len = np.zeros((mesh, s_total), np.int32)
+        seg_rank = np.full((mesh, s_total), 0xFFFFFFFF, np.uint32)
+        for d in range(mesh):
+            for q in range(s_total):
+                sp = q * mesh + d
+                seg_len[d, q] = int(owned[sp])
+                parent, j = sp % num_parts, sp // num_parts
+                if start <= parent < end:
+                    seg_rank[d, q] = (parent - start) * k + j
+        lens = self.runtime.shard_rows(seg_len)
+        ranks = self.runtime.shard_rows(seg_rank)
+
+        w = out.shape[0]
+        mode = self._exchange.sort_mode(w)
+        key = ("splitfilter", cap, w, s_total, mode)
+        fn = self._filter_cache.get(key)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from sparkrdma_tpu.kernels.sort import sort_by_lead_cols
+            from sparkrdma_tpu.utils.compat import shard_map
+
+            ax = self.runtime.axis_name
+            sentinel = jnp.uint32(0xFFFFFFFF)
+
+            def local_filter(cols, sl, rk):
+                sl, rk = sl[0], rk[0]                       # [S]
+                bounds = jnp.cumsum(sl)                     # incl. ends
+                r = jnp.arange(cap, dtype=jnp.int32)
+                s_ix = jnp.minimum(
+                    jnp.searchsorted(bounds, r, side="right"), s_total - 1)
+                rank = jnp.where(r < bounds[-1], jnp.take(rk, s_ix),
+                                 sentinel)
+                ln = jnp.sum(rank != sentinel).astype(jnp.int32)
+                live = (r < ln)
+                packed = sort_by_lead_cols(cols, rank, mode)
+                packed = packed * live[None].astype(packed.dtype)
+                return packed, ln[None]
+
+            fn = jax.jit(shard_map(
+                local_filter, mesh=self.runtime.mesh,
+                in_specs=(P(None, ax), P(ax), P(ax)),
+                out_specs=(P(None, ax), P(ax)),
+            ))
+            self._filter_cache[key] = fn
+        return fn(out, lens, ranks)
+
     def _aggregated(self, out: jax.Array, totals: jax.Array,
                     plan: ShufflePlan, op: str,
                     float_payload: bool) -> Tuple[jax.Array, jax.Array]:
@@ -652,13 +747,14 @@ class ShuffleManager:
 
             ax = self.runtime.axis_name
 
-            wide = self._exchange._wide_sort(out.shape[0])
+            mode = self._exchange.sort_mode(out.shape[0])
+            pack, wide = mode == "pack", mode == "wide"
 
             def local_agg(cols, total):
                 valid = jnp.arange(cap) < total[0]
                 combined, nuniq = combine_by_key_cols(
                     cols, valid, key_words, op, float_payload, wide=wide,
-                    ride_words=self.conf.wide_sort_ride_words)
+                    ride_words=self.conf.wide_sort_ride_words, pack=pack)
                 return combined, nuniq[None]
 
             fn = jax.jit(shard_map(
@@ -686,23 +782,30 @@ class ShuffleManager:
 
             from sparkrdma_tpu.kernels.merge_sort import (merge_sort_cols,
                                                           supports_fast_sort)
+            from sparkrdma_tpu.kernels.sort import packed_lexsort_cols
             from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
             fast = (self.conf.fast_sort
+                    and not self.conf.stable_key_sort
                     and supports_fast_sort(cap, self.conf.fast_sort_run))
-            wide = self._exchange._wide_sort(w)
+            mode = self._exchange.sort_mode(w)
+            pack, wide = mode == "pack", mode == "wide"
 
             def local_sort(cols, total):
                 valid = jnp.arange(cap) < total[0]
                 if fast:   # same contract note as the fused tail
                     return merge_sort_cols(cols, valid,
                                            run=self.conf.fast_sort_run)
+                if pack:
+                    return packed_lexsort_cols(
+                        cols, key_words, valid,
+                        stable=self.conf.stable_key_sort)
                 if wide:
                     return sort_wide_cols(
                         cols, key_words, valid,
                         ride_words=self.conf.wide_sort_ride_words)
                 return lexsort_cols(cols, key_words, valid,
-                                    stable=False)
+                                    stable=self.conf.stable_key_sort)
 
             fn = jax.jit(shard_map(
                 local_sort, mesh=self.runtime.mesh,

@@ -121,6 +121,12 @@ class ShuffleConf:
     #: default suits real record counts; tests lower it to exercise the
     #: fast path at CPU-mesh sizes.
     fast_sort_run: int = 1 << 15
+    #: keep arrival order within equal keys on key-ordered reads.
+    #: Spark's sortByKey contract does NOT promise this (so the default
+    #: rides the cheaper unstable network and permits fast_sort); turn
+    #: on for callers that layered meaning onto arrival order. Wide
+    #: records (the key+index path) are stable either way.
+    stable_key_sort: bool = False
 
     #: payload width (in uint32 words) at or above which key-ordering
     #: sorts use the WIDE-RECORD path: ride ``wide_sort_ride_words``
@@ -139,6 +145,33 @@ class ShuffleConf:
     #: are placed by one gather pass): 10 + 2 keys + index = 13
     #: operands, the measured knee of the sort-cost curve.
     wide_sort_ride_words: int = 10
+    #: payload width (words) at or above which full-record sorts use u64
+    #: OPERAND PACKING (kernels/sort.py §packed_lexsort_cols): pairs of
+    #: u32 words ride as one u64 operand, halving operand count at equal
+    #: bytes — the whole record rides, no gather pass at all.
+    #:
+    #: Round-5 v5e measurements (three layers, each overturning the
+    #: last — scripts/profile12.py + bench.py A/B hooks):
+    #: - standalone same-process, 16M records: packed wins at both
+    #:   bench widths (W=25: 620ms vs 625 mono vs 805 ride+gather;
+    #:   W=13: 387 vs 439);
+    #: - FUSED full pipeline: the standalone wins do NOT survive fusion
+    #:   — plain monolithic beats packed at W=13 (3.74 vs 3.57 GB/s)
+    #:   AND at W=25 (3.88 vs 3.63, back-to-back), both beating
+    #:   round-4's ride/gather default (2.69) by far;
+    #: - compile time still favors packing ~3x at W=25 (fused mono
+    #:   compiles ~25 min over this tunnel, once, then cached).
+    #:
+    #: DEFAULT POLICY: 20 — wide records pack by default, because the
+    #: default serves arbitrary user verbs at arbitrary widths, where
+    #: the bounded operand count caps both compile time (the round-3
+    #: 40-minute 25-operand walls) and the deep superlinear zone, at a
+    #: measured ~6% runtime cost at W=25. A stable, benched geometry
+    #: should opt into the monolithic tail (pack_sort_min_payload
+    #: above the payload width) exactly as bench.py does — same
+    #: opt-in philosophy as geometry_classes="fine". Takes precedence
+    #: over the wide ride/gather path when both trigger; 0 disables.
+    pack_sort_min_payload: int = 20
 
     # --- observability ---
     collect_shuffle_read_stats: bool = False
@@ -151,6 +184,14 @@ class ShuffleConf:
     spill_to_host: bool = False
     spill_dir: str = ""               # checkpoint root (empty = no store)
     use_native_staging: bool = True   # C++ staging pool when available
+    #: optional codec for spill runs + checkpoints: "" (off, default),
+    #: "zlib" or "lzma" (both stdlib). STORAGE-side only — the
+    #: fabric-side decision is a measured NO (ICI/HBM pipeline ~GB/s vs
+    #: zlib decompress ~0.1-0.3 GB/s/core; scripts/compress_note.py) —
+    #: mirroring where the reference's "decompress" stage actually
+    #: lives: Spark's shuffle files, not the NIC (SURVEY.md §3.3).
+    compression: str = ""
+    compression_level: int = 1        # zlib 1-9 / lzma preset 0-9
 
     def __post_init__(self) -> None:
         if self.slot_records <= 0:
@@ -172,9 +213,17 @@ class ShuffleConf:
             raise ValueError("wide_sort_min_payload must be >= 0")
         if self.wide_sort_ride_words < 0:
             raise ValueError("wide_sort_ride_words must be >= 0")
+        if self.pack_sort_min_payload < 0:
+            raise ValueError("pack_sort_min_payload must be >= 0")
         if self.geometry_classes not in ("pow2", "fine"):
             raise ValueError(
                 f"unknown geometry_classes {self.geometry_classes!r}")
+        if self.compression not in ("", "zlib", "lzma"):
+            raise ValueError(
+                f"unknown compression {self.compression!r} "
+                "(supported: '', 'zlib', 'lzma')")
+        if not 0 <= self.compression_level <= 9:
+            raise ValueError("compression_level must be in [0, 9]")
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property

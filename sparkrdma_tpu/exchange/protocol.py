@@ -346,6 +346,7 @@ class ShuffleExchange:
 
         return (bool(sort_key_words) and not aggregator
                 and self.conf.fast_sort
+                and not self.conf.stable_key_sort  # kernel is unstable
                 and supports_fast_sort(out_capacity,
                                        self.conf.fast_sort_run))
 
@@ -357,17 +358,20 @@ class ShuffleExchange:
         full (totals == out_capacity), so the sort can drop its
         validity lead operand — one fewer array through the comparator
         network."""
-        wide = self._wide_sort(out.shape[0])
+        mode = self.sort_mode(out.shape[0])
         if aggregator:
             from sparkrdma_tpu.kernels.aggregate import combine_by_key_cols
 
             valid = jnp.arange(out_capacity) < total
             out, total = combine_by_key_cols(
                 out, valid, self.conf.key_words, aggregator, float_payload,
-                wide=wide, ride_words=self.conf.wide_sort_ride_words)
+                wide=(mode == "wide"),
+                ride_words=self.conf.wide_sort_ride_words,
+                pack=(mode == "pack"))
         elif sort_key_words:
             from sparkrdma_tpu.kernels.merge_sort import merge_sort_cols
-            from sparkrdma_tpu.kernels.sort import lexsort_cols
+            from sparkrdma_tpu.kernels.sort import (lexsort_cols,
+                                                    packed_lexsort_cols)
             from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
             valid = (None if tight_out
@@ -377,24 +381,51 @@ class ShuffleExchange:
                 # Pallas merge-path sort: full-record order (sorted by
                 # the key words; payload words break ties), not stable —
                 # the ExternalSorter contract Spark actually gives for
-                # sortByKey. Stability needed? conf.fast_sort=False.
+                # sortByKey. Stable arrival order within equal keys is
+                # opt-in via conf.stable_key_sort (which disables this
+                # kernel and the unstable fallback below).
                 out = merge_sort_cols(out, valid,
                                       run=self.conf.fast_sort_run)
-            elif wide:
+            elif mode == "pack":
+                out = packed_lexsort_cols(
+                    out, sort_key_words, valid,
+                    stable=self.conf.stable_key_sort)
+            elif mode == "wide":
                 out = sort_wide_cols(out, sort_key_words, valid,
                                      ride_words=self.conf.wide_sort_ride_words)
             else:
                 # key-ordering only: Spark's sortByKey promises no
                 # secondary order, so the cheaper unstable network is
-                # contract-accurate here
+                # contract-accurate by default; stable_key_sort restores
+                # arrival-order ties for callers that need them
                 out = lexsort_cols(out, sort_key_words, valid,
-                                   stable=False)
+                                   stable=self.conf.stable_key_sort)
         return out, total
 
     def _wide_sort(self, record_words: int) -> bool:
-        """Payload wide enough for the key+index sort + placement path?"""
+        """Payload wide enough for the key+index sort + placement path?
+        (Only reached when packing is off — see :meth:`sort_mode`.)"""
         t = self.conf.wide_sort_min_payload
         return bool(t) and record_words - self.conf.key_words >= t
+
+    def _pack_sort(self, record_words: int) -> bool:
+        """Payload wide enough for u64 operand packing? Takes precedence
+        over the ride/gather wide path (round-5 measured winner)."""
+        t = self.conf.pack_sort_min_payload
+        return bool(t) and record_words - self.conf.key_words >= t
+
+    def sort_mode(self, record_words: int) -> str:
+        """THE precedence rule for full-record sorts at this geometry:
+        ``"pack"`` (u64 operand packing) > ``"wide"`` (key+index sort +
+        gather placement) > ``"plain"`` (monolithic variadic sort).
+        Every site that picks a sort strategy — fused tail, map-side
+        bucket, combine/group/densify/filter compactions — asks here,
+        so the rule cannot silently diverge between paths."""
+        if self._pack_sort(record_words):
+            return "pack"
+        if self._wide_sort(record_words):
+            return "wide"
+        return "plain"
 
     # ------------------------------------------------------------------
     # phase 2, regime A: one fused program
@@ -449,10 +480,12 @@ class ShuffleExchange:
 
             # --- map side: bucket into per-partition runs -------------
             pids = partitioner(records).astype(jnp.int32)
+            mode = self.sort_mode(records.shape[0])
             sr, counts, offs = bucket_records(
                 records, pids, num_parts,
-                wide=self._wide_sort(records.shape[0]),
-                ride_words=self.conf.wide_sort_ride_words)
+                wide=(mode == "wide"),
+                ride_words=self.conf.wide_sort_ride_words,
+                pack=(mode == "pack"))
 
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
@@ -536,10 +569,12 @@ class ShuffleExchange:
 
         def local_prep(records):
             pids = partitioner(records).astype(jnp.int32)
+            mode = self.sort_mode(records.shape[0])
             sr, counts, offs = bucket_records(
                 records, pids, num_parts,
-                wide=self._wide_sort(records.shape[0]),
-                ride_words=self.conf.wide_sort_ride_words)
+                wide=(mode == "wide"),
+                ride_words=self.conf.wide_sort_ride_words,
+                pack=(mode == "pack"))
             dev_counts = _device_partition_counts(
                 counts, num_parts, mesh_size, ax)
             incoming = lax.all_to_all(

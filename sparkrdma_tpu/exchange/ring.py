@@ -13,8 +13,10 @@ QP-pair analogue).
 Default transport remains XLA's ``lax.all_to_all`` (the compiler schedules
 and overlaps it well); this backend exists because the reference's
 defining capability is a *user-controlled* one-sided transport, and
-because explicit descriptors allow schedules XLA will not emit (e.g.
-priority-tiered sends, compute overlap inside one kernel). Select with
+because explicit descriptors COULD allow schedules XLA will not emit
+(priority-tiered sends, in-kernel compute overlap). None of those
+schedules are implemented here — this kernel issues plain pairwise
+sends; the claim is a direction, not a feature. Select with
 ``ShuffleConf(transport="pallas_ring")``.
 
 Algorithm: direct pairwise sends — P-1 remote copies per device, chunk
@@ -31,9 +33,13 @@ and executes the kernel on real TPU hardware — on the single attached
 chip that exercises the Mosaic-lowered local-DMA + semaphore path
 (byte-identical to ``lax.all_to_all``), while the remote-DMA sends and
 barrier handshake compile but need a multi-chip pod to execute. The
-docstring's promised scheduling advantages (priority tiers, in-kernel
-compute overlap) therefore remain UNPROVEN on this hardware; until a
-pod run shows a schedule XLA won't emit, prefer ``transport="xla"``.
+POD-READINESS pack is ``scripts/ring_pod.py`` (round 5): the day this
+repo runs where ``len(jax.devices()) >= 2``, it executes the remote-DMA
++ barrier legs end to end and asserts parity against ``lax.all_to_all``
+— until then it refuses loudly instead of pretending. Measured single-
+chip result (round 4, scripts/ring_vs_xla.py): the local leg runs 9%
+faster than the XLA transport; everything beyond that is unproven on
+this hardware, so prefer ``transport="xla"``.
 """
 
 from __future__ import annotations

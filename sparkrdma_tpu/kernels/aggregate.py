@@ -67,18 +67,24 @@ def combine_by_key_cols(
     float_payload: bool = False,
     wide: bool = False,
     ride_words: int = 0,
+    pack: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Reduce payloads of equal keys; return ``(combined, num_unique)``.
 
     ``cols: uint32[W, N]`` with leading ``key_words`` key rows. Output
     keeps shape ``[W, N]``: the first ``num_unique`` columns are unique
     keys (sorted ascending) with reduced payloads; tail is zero padding.
-    ``wide`` routes both sorts through the key+index wide-record path
-    (kernels/wide_sort.py) so wide payloads never ride the comparator
-    network — same contract, chosen by the caller's record geometry.
+    ``pack`` routes both sorts through u64 operand packing (round-5
+    winner, kernels/sort.py); ``wide`` through the key+index ride/gather
+    path (the round-4 fallback) — either way wide payloads never meet
+    the >13-operand comparator wall; same output contract.
     """
     w, n = cols.shape
-    if wide:
+    if pack:
+        from sparkrdma_tpu.kernels.sort import packed_lexsort_cols
+
+        srt = packed_lexsort_cols(cols, key_words, valid, stable=True)
+    elif wide:
         from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
 
         srt = sort_wide_cols(cols, key_words, valid,
@@ -115,13 +121,22 @@ def combine_by_key_cols(
     next_same = jnp.concatenate([same[1:], jnp.zeros((1,), bool)])
     last_of_run = in_valid & ~next_same
     lead = (~last_of_run).astype(jnp.uint8)
-    if wide:
+    if pack:
+        from sparkrdma_tpu.kernels.sort import packed_partition_cols
+
+        full = jnp.concatenate([keys, red], axis=0)
+        _, out = packed_partition_cols(full, lead.astype(jnp.uint32),
+                                       stable=True)
+    elif wide:
         # compact via a (flag, ridden words..., index) sort + one gather
         # pass instead of riding all W words through the network again
         from sparkrdma_tpu.kernels.wide_sort import apply_perm
 
         full = jnp.concatenate([keys, red], axis=0)
-        ride = max(0, min(ride_words, w))
+        # ride_words is a PAYLOAD-word budget (sort_wide_cols semantics):
+        # the key words ride for free on top of it, so the measured
+        # 13-operand knee applies uniformly to both wide paths
+        ride = min(key_words + max(0, ride_words), w)
         idx = lax.iota(jnp.int32, n)
         operands = (lead,) + tuple(full[i] for i in range(ride)) + (idx,)
         packed = lax.sort(operands, num_keys=1, is_stable=True)

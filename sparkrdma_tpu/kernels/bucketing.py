@@ -75,7 +75,7 @@ def histogram_pids(part_ids: jax.Array, num_parts: int,
 
 def bucket_records(
     records: jax.Array, part_ids: jax.Array, num_parts: int,
-    wide: bool = False, ride_words: int = 0
+    wide: bool = False, ride_words: int = 0, pack: bool = False
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Stable-sort a columnar batch ``[W, N]`` by destination partition.
 
@@ -87,10 +87,12 @@ def bucket_records(
     within a partition); counts come from the sorted pid vector (see
     :func:`histogram_pids`), not a scatter.
 
-    ``wide``: for wide records, sort only ``(pid, index)`` and place the
-    record words with one gather pass instead of riding all ``W`` word
-    columns through the comparator network (see kernels/wide_sort.py's
-    rationale — same cost structure on the map side).
+    ``pack`` (takes precedence): ride the whole record as u64-PACKED
+    operands — pid + ceil(W/2) operands, no gather pass (round-5
+    measured winner for wide records, kernels/sort.py
+    §packed_lexsort_cols). ``wide``: sort only ``(pid, ride..., index)``
+    and place the remaining words with one gather pass (the round-4
+    fallback, kept for hardware where packing measures worse).
     """
     w, n = records.shape
     if num_parts == 1:
@@ -102,6 +104,17 @@ def bucket_records(
                 jnp.full((1,), n, jnp.int32),
                 jnp.zeros((1,), jnp.int32))
     part_ids = part_ids.astype(jnp.int32)
+    if pack:
+        from sparkrdma_tpu.kernels.sort import packed_partition_cols
+
+        sorted_ids_u32, bucketed = packed_partition_cols(
+            records, part_ids.astype(jnp.uint32), stable=True)
+        sorted_ids = sorted_ids_u32.astype(jnp.int32)
+        counts = histogram_pids(part_ids, num_parts, sorted_ids=sorted_ids)
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+        return bucketed, counts, offsets
     if wide:
         from sparkrdma_tpu.kernels.wide_sort import apply_perm
 

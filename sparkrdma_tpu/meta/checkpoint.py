@@ -43,11 +43,17 @@ class MapOutputStore:
     """Directory-backed store: one subdir per shuffle id."""
 
     def __init__(self, root: str, use_native: bool = True,
-                 spool_depth: int = 4):
+                 spool_depth: int = 4, compression: str = "",
+                 compression_level: int = 1):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.use_native = use_native
         self.spool_depth = spool_depth
+        # optional storage codec (round 5): checkpoints shrink when the
+        # data compresses; readers auto-detect (host_staging.read_array),
+        # so stores with different settings interoperate
+        self.compression = compression
+        self.compression_level = compression_level
 
     # ------------------------------------------------------------------
     def _dir(self, shuffle_id: int) -> Path:
@@ -69,7 +75,9 @@ class MapOutputStore:
         tmp.mkdir(parents=True)
         records = np.ascontiguousarray(records, dtype=np.uint32)
         spool = SpillWriter(depth=self.spool_depth,
-                            use_native=self.use_native)
+                            use_native=self.use_native,
+                            codec=self.compression,
+                            level=self.compression_level)
         try:
             spool.submit(str(tmp / _RECORDS), records)
             errors = spool.drain()
@@ -138,7 +146,9 @@ class MapOutputStore:
         d.mkdir(parents=True, exist_ok=True)
         save_id = self._save_id(plan, global_shape)
         spool = SpillWriter(depth=self.spool_depth,
-                            use_native=self.use_native)
+                            use_native=self.use_native,
+                            codec=self.compression,
+                            level=self.compression_level)
         tmp_paths = []
         try:
             for coord, data in shards:

@@ -34,6 +34,15 @@ import numpy as np
 from sparkrdma_tpu.runtime.mesh import ManagerId
 
 
+class DuplicateShuffleIdError(ValueError):
+    """A shuffle id is already registered on this manager.
+
+    Distinct type so callers that auto-draw ids (the Dataset layer) can
+    retry on exactly this condition without swallowing other future
+    registry validation errors.
+    """
+
+
 @dataclasses.dataclass
 class ShuffleMeta:
     """Everything the control plane knows about one registered shuffle."""
@@ -73,7 +82,8 @@ class MapOutputRegistry:
                  partitioner: Callable) -> ShuffleMeta:
         with self._lock:
             if shuffle_id in self._shuffles:
-                raise ValueError(f"shuffle {shuffle_id} already registered")
+                raise DuplicateShuffleIdError(
+                    f"shuffle {shuffle_id} already registered")
             meta = ShuffleMeta(shuffle_id, num_parts, partitioner)
             self._shuffles[shuffle_id] = meta
             return meta
@@ -98,4 +108,5 @@ class MapOutputRegistry:
             return tuple(self._shuffles)
 
 
-__all__ = ["MapOutputRegistry", "ShuffleMeta"]
+__all__ = ["MapOutputRegistry", "ShuffleMeta",
+           "DuplicateShuffleIdError"]

@@ -111,7 +111,9 @@ def run_streaming_terasort(
     part = range_partitioner(splitters, kw)
     del first_host
 
-    spiller = SpillWriter(use_native=manager.conf.use_native_staging) \
+    spiller = SpillWriter(use_native=manager.conf.use_native_staging,
+                          codec=manager.conf.compression,
+                          level=manager.conf.compression_level) \
         if spill_dir else None
     run_paths = []
     acc = None          # conservation accumulator (no-spill mode)

@@ -315,3 +315,53 @@ def test_count_by_key_matches_numpy(manager, rng):
         ref[(0, int(k))] = ref.get((0, int(k)), 0) + 1
     got_map = {(int(r[0]), int(r[1])): int(r[2]) for r in got}
     assert got_map == ref
+
+
+def test_chained_verbs_stay_on_device(manager, rng):
+    """Re-densification between chained verbs must run on DEVICE (round
+    5): the old convenience path pulled the whole Dataset through
+    to_host_rows; now a padded chain must never call it internally —
+    patched here to raise — and parity must hold."""
+    n = 8 * 32
+    x = np.zeros((n, 4), dtype=np.uint32)
+    x[:, 1] = rng.integers(1, 20, size=n)
+    x[:, 2] = 1
+    ds = Dataset.from_host_rows(manager, x).reduce_by_key("sum")
+    uniq = ds.count
+    assert int(np.asarray(ds.totals).sum()) != ds.records.shape[1], \
+        "test needs a padded Dataset to exercise re-densification"
+    import unittest.mock as mock
+
+    def boom(self):
+        raise AssertionError("full-dataset host round-trip in a chain")
+
+    with mock.patch.object(Dataset, "to_host_rows", boom):
+        ds2 = ds.repartition()
+        ds3 = ds2.sort_by_key()
+        assert ds3.count == uniq       # device-side count, no host trip
+    ref = {}
+    for i in range(n):
+        k = (0, int(x[i, 1]))
+        ref[k] = ref.get(k, 0) + 1
+    got = {(int(r[0]), int(r[1])): int(r[2]) for r in ds3.to_host_rows()}
+    assert got == ref
+
+
+def test_dense_records_skewed_devices(manager, rng):
+    """Device-side densification with wildly unequal per-device valid
+    counts (one device nearly empty): filler columns must pad every
+    device to the shared capacity and downstream verbs must exclude
+    them."""
+    import jax.numpy as jnp
+
+    n = 8 * 40
+    x = rng.integers(1, 2**31, size=(n, 4), dtype=np.uint32)
+    ds = Dataset.from_host_rows(manager, x)
+    # fake a skewed padded Dataset: device 0 keeps 1 record, others all
+    totals = np.full((8,), 40, np.int32)
+    totals[0] = 1
+    skewed = Dataset(manager, ds.records, jnp.asarray(totals))
+    kept = skewed.to_host_rows()
+    assert kept.shape[0] == 7 * 40 + 1
+    got = skewed.repartition().to_host_rows()
+    np.testing.assert_array_equal(canon(got), canon(kept))

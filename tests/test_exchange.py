@@ -35,6 +35,14 @@ def make_global_records(rng, rt, n_per_dev, w=4):
     return rt.shard_records(x), x
 
 
+def collect_valid_rows(out, totals, cap):
+    """Valid rows of a padded columnar result, concatenated device order."""
+    arr = np.asarray(out)
+    return np.concatenate(
+        [arr[:, d * cap:d * cap + int(totals[d])].T
+         for d in range(len(totals))])
+
+
 def np_reference_shuffle(x, pids, num_parts, mesh_size, n_per_dev):
     """Expected per-device received sets, honoring (partition, source) order."""
     out = {}
@@ -153,7 +161,12 @@ def test_plan_splits_excessive_skew(exchange, rng):
     np.testing.assert_array_equal(canon(dev0), canon(x))
 
 
-def test_split_plan_rejects_partition_range_reads(rng):
+def test_split_plan_serves_partition_range_reads(rng):
+    """Ranged reads on a SKEW-SPLIT plan must return exactly the ranged
+    partitions' records (the reference's RdmaMappedFile serves any
+    partition range unconditionally — splitting is our plan-time
+    artifact and must stay invisible to readers). Records land skewed:
+    most in partition 0 (forcing the split), some in partitions 1/2."""
     from sparkrdma_tpu import MeshRuntime
     from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 
@@ -161,13 +174,38 @@ def test_split_plan_rejects_partition_range_reads(rng):
     with ShuffleManager(MeshRuntime(conf), conf) as m:
         part = modulo_partitioner(8)
         x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
-        x[:, 0] = 0
+        x[:, 0] = np.where(np.arange(x.shape[0]) % 8 < 6, 0,
+                           np.arange(x.shape[0]) % 8).astype(np.uint32)
         h = m.register_shuffle(60, 8, part)
-        m.get_writer(h).write(m.runtime.shard_records(x)).stop(True)
-        out, totals = m.get_reader(h).read()   # full range is fine
+        plan = m.get_writer(h).write(m.runtime.shard_records(x)).stop(True)
+        assert plan.split_factor > 1
+        canon = lambda a: a[np.lexsort(tuple(a[:, c]
+                                             for c in range(a.shape[1])))]
+
+        def expect(lo, hi):
+            return x[(x[:, 0] % 8 >= lo) & (x[:, 0] % 8 < hi)]
+
+        # full range still exact
+        out, totals = m.get_reader(h).read()
         assert int(np.asarray(totals).sum()) == x.shape[0]
-        with pytest.raises(ValueError, match="skew-split"):
-            m.get_reader(h, 0, 1).read()
+        # ranged read over the hot partition + a cold one
+        out, totals = m.get_reader(h, 0, 2).read()
+        got = collect_valid_rows(out, np.asarray(totals),
+                                 plan.out_capacity)
+        np.testing.assert_array_equal(canon(got), canon(expect(0, 2)))
+        # ranged read excluding the hot partition
+        out, totals = m.get_reader(h, 6, 8).read()
+        got = collect_valid_rows(out, np.asarray(totals),
+                                 plan.out_capacity)
+        np.testing.assert_array_equal(canon(got), canon(expect(6, 8)))
+        # single-partition host view concatenates the sub-partitions
+        p0 = m.get_reader(h).read_partition(0)
+        np.testing.assert_array_equal(canon(p0), canon(expect(0, 1)))
+        # refcounted per-partition views work too
+        view = m.get_reader(h).read_view()
+        v2 = np.asarray(view.partition(2)).T
+        np.testing.assert_array_equal(canon(v2), canon(expect(2, 3)))
+        view.release()
         m.unregister_shuffle(60)
 
 

@@ -88,3 +88,57 @@ def test_merge_sorted_runs(rng):
     if ref is not None:
         np.testing.assert_array_equal(np.asarray(merged[: int(total)]), ref)
     assert not np.any(np.asarray(merged[int(total):]))
+
+
+# --- u64 operand packing (round 5) -----------------------------------
+
+def _canon_cols(a):
+    import numpy as np
+    return a[:, np.lexsort(tuple(a[c] for c in range(a.shape[0] - 1, -1,
+                                                     -1)))]
+
+
+@pytest.mark.parametrize("w,kw", [(25, 2), (13, 2), (26, 1), (9, 3),
+                                  (4, 2), (5, 4)])
+def test_packed_lexsort_matches_unpacked(rng, w, kw):
+    """packed_lexsort_cols == lexsort_cols for every key/payload parity
+    (even/odd key words, even/odd payload words). Multiset equality for
+    full records; exact key-column order equality."""
+    import jax.numpy as jnp
+    from sparkrdma_tpu.kernels.sort import lexsort_cols, packed_lexsort_cols
+
+    n = 1 << 11
+    cols = rng.integers(0, 2**32, size=(w, n), dtype=np.uint32)
+    cols[:kw, : n // 4] = cols[:kw, n // 4: n // 2]   # duplicate keys
+    x = jnp.asarray(cols)
+    got = np.asarray(packed_lexsort_cols(x, kw))
+    ref = np.asarray(lexsort_cols(x, kw, stable=False))
+    np.testing.assert_array_equal(got[:kw], ref[:kw])
+    np.testing.assert_array_equal(_canon_cols(got), _canon_cols(ref))
+
+
+def test_packed_lexsort_valid_padding_and_stability(rng):
+    import jax.numpy as jnp
+    from sparkrdma_tpu.kernels.sort import lexsort_cols, packed_lexsort_cols
+
+    n = 1 << 10
+    cols = np.zeros((7, n), dtype=np.uint32)
+    cols[0] = rng.integers(0, 4, size=n)
+    cols[1] = 0
+    cols[2] = np.arange(n)                       # arrival marker
+    valid = rng.random(n) < 0.8
+    x = jnp.asarray(cols)
+    v = jnp.asarray(valid)
+    got = np.asarray(packed_lexsort_cols(x, 2, v, stable=True))
+    ref = np.asarray(lexsort_cols(x, 2, v, stable=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_packed_lexsort_leaves_x64_flag_off():
+    import jax
+    import jax.numpy as jnp
+    from sparkrdma_tpu.kernels.sort import packed_lexsort_cols
+
+    x = jnp.zeros((4, 128), jnp.uint32)
+    jax.jit(lambda c: packed_lexsort_cols(c, 2))(x)
+    assert not jax.config.jax_enable_x64
