@@ -1,0 +1,129 @@
+"""Serde-encoded byte payloads at bench scale on TPU (VERDICT r4 #3).
+
+The reference shuffles SERIALIZED OBJECTS (SURVEY.md §3.3); this
+framework's codec (api/serde.py) maps variable-length byte payloads
+onto fixed-width records. Rounds 1-4 only ever exercised the codec at
+test scale — this script runs the full pipeline at bench scale on the
+real chip:
+
+1. HOST ENCODE: ~8M variable-length payloads (0-92 bytes, mean ~46) are
+   bulk-encoded into 104-byte records (2 key words + length word + 23
+   payload words) — the vectorized round-5 codec.
+2. DEVICE SHUFFLE: full range-partition + exchange + fused key-ordered
+   sort over the encoded records, repeated for steady state, verified
+   on device (conservation + order invariants).
+3. SAMPLE DECODE: a window per device comes back to host and decodes;
+   payloads are a deterministic function of the key (key bytes tiled to
+   a key-derived length), so decoded bytes are self-checking without a
+   giant host-side reference.
+
+Prints ONE JSON line with the device-side GB/s over ENCODED bytes (the
+wire format, what the fabric actually moves — same accounting as the
+reference's compressed-block GB/s).
+
+Env: BENCH_RECORDS_PER_DEVICE (default 8M), BENCH_REPEATS (default 8).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.serde import (decode_bytes_rows, encode_bytes_rows,
+                                     payload_words)
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.workloads.terasort import run_terasort
+
+MAX_PAYLOAD = 92
+
+
+def expected_payload(hi: int, lo: int) -> bytes:
+    """Deterministic payload of a key: its 8 bytes tiled to a
+    key-derived length in [0, MAX_PAYLOAD]."""
+    ln = (hi ^ lo) % (MAX_PAYLOAD + 1)
+    pat = hi.to_bytes(4, "little") + lo.to_bytes(4, "little")
+    return (pat * 12)[:ln]
+
+
+def main() -> int:
+    n = int(os.environ.get("BENCH_RECORDS_PER_DEVICE", 8 * 1024 * 1024))
+    repeats = int(os.environ.get("BENCH_REPEATS", 8))
+    rng = np.random.default_rng(7)
+    import time
+
+    t0 = time.perf_counter()
+    keys = rng.integers(0, 2**32, size=(n, 2), dtype=np.uint32)
+    # bulk-build the self-checking payloads: pattern = key bytes, length
+    # = key-derived; one big byte matrix sliced per row at C speed
+    lens = ((keys[:, 0] ^ keys[:, 1]) % (MAX_PAYLOAD + 1)).astype(np.int64)
+    pat = np.zeros((n, 96), dtype=np.uint8)
+    le = keys.astype("<u4").view(np.uint8).reshape(n, 8)
+    for r in range(12):
+        pat[:, r * 8:(r + 1) * 8] = le
+    whole = pat.tobytes()
+    payloads = [whole[i * 96: i * 96 + ln]
+                for i, ln in enumerate(lens.tolist())]
+    rows = encode_bytes_rows(keys, payloads, MAX_PAYLOAD)
+    encode_s = time.perf_counter() - t0
+    w = rows.shape[1]
+    assert w == 2 + payload_words(MAX_PAYLOAD)
+
+    conf = ShuffleConf(slot_records=max(4096, n), max_rounds=64,
+                       max_slot_records=max(1 << 22, 2 * n),
+                       val_words=w - 2, geometry_classes="fine")
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    try:
+        records = manager.runtime.shard_records(rows)
+        res, out, totals = run_terasort(
+            manager, records_per_device=n, input_records=records,
+            verify=False, device_verify=True, warmup=True,
+            repeats=repeats, shuffle_id=0)
+        if not res.verified:
+            print(json.dumps({"error": "device verification FAILED"}))
+            return 1
+        # sample decode: 4096 columns per device, content self-check
+        mesh = manager.runtime.num_partitions
+        cap = out.shape[1] // mesh
+        tot = np.asarray(totals)
+        checked = 0
+        for d in range(mesh):
+            k = min(int(tot[d]), 4096)
+            win = np.asarray(out[:, d * cap: d * cap + k]).T
+            got_keys, got_payloads = decode_bytes_rows(win, 2)
+            for i in range(k):
+                exp = expected_payload(int(got_keys[i, 0]),
+                                       int(got_keys[i, 1]))
+                if got_payloads[i] != exp:
+                    print(json.dumps({"error": f"payload mismatch at "
+                                               f"device {d} row {i}"}))
+                    return 1
+            checked += k
+        gbps = res.gbps / mesh
+        print(json.dumps({
+            "metric": "serde_shuffle_gbps_per_chip",
+            "value": round(gbps, 3),
+            "unit": "GB/s/chip",
+            "record_bytes": w * 4,
+            "payload": "variable 0-92B, mean ~46B",
+            "host_encode_mbps": round(n * w * 4 / encode_s / 1e6, 1),
+            "decoded_rows_verified": checked,
+        }))
+        return 0
+    finally:
+        manager.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""Round-5 width study: where do the faithful 100B config's ms go, and
+does u64 OPERAND PACKING move the sort floor?
+
+Round 4 established (README "sort floor"): monolithic variadic sort cost
+at 16M records is 82/123/202/630 ms at 4/8/13/25 u32 operands —
+superlinear in OPERAND COUNT past ~13, NOT in bytes (52B in 13 operands:
+202ms; 100B in 25: 630ms, though only 1.9x the bytes). If the blowup is
+per-operand (register pressure / per-operand routing through the
+network), then carrying the same 100 bytes in 13 operands (1 u64 key +
+11 u64 + 1 u32 payload words, bitcast-packed) should cost near the
+52B/13-operand point scaled by bytes — ~300ms instead of 630/544 — which
+would lift the faithful config past the round-4 width-optimal headline.
+
+Cases (PROF_CASE):
+  tail100   piece accounting of the current W=25 fused tail: full
+            sort_wide_cols(ride=10) vs its sort-only and gather-only
+            components (locates the unexplained ~50ms of bench.py's
+            measured 595ms/iter vs the 544ms component sum).
+  ride      u32 wide-path ride sweep r in {0, 5, 8, 13}.
+  packmono  bitcast-packed monolithic sort (13 operands, 100B riding).
+  packwide  packed wide path: u64 key + {3, 5} u64 ridden pairs + idx,
+            gather the rest — for if packmono's full ride loses.
+  x64check  parity check: packed sort == reference lexsort (small N).
+
+All cases run with the persistent cache (PROF_CACHE_DIR) so wide-sort
+compiles are one-time. PROF_KS=1 uses single-program timing.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cache_dir = os.environ.get("PROF_CACHE_DIR")
+
+import jax
+
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+W = 25
+KW = 2
+
+
+def perturb(c):
+    return c ^ (c << 13) ^ (c >> 7)
+
+
+def time_op(name, fn, x, bytes_moved=None):
+    ks = (1,) if os.environ.get("PROF_KS") == "1" else (1, 3)
+
+    def chained(k):
+        def f(x):
+            for i in range(k):
+                x = fn(perturb(x) if i > 0 else x)
+            return x
+        return jax.jit(f)
+
+    times = []
+    t0 = time.perf_counter()
+    for k in ks:
+        g = chained(k)
+        out = g(x)
+        barrier(out)
+        if k == ks[0]:
+            compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0_ = time.perf_counter()
+            out = g(x)
+            barrier(out)
+            ts.append(time.perf_counter() - t0_)
+        times.append(min(ts))
+    slope = ((times[-1] - times[0]) / (ks[-1] - ks[0])
+             if len(ks) > 1 else times[0])
+    msg = f"{name:46s} per-op {slope*1e3:8.2f} ms"
+    if bytes_moved:
+        msg += f"  = {bytes_moved / slope / 1e9:6.2f} GB/s"
+    msg += f"   (compile+first {compile_s:.1f}s)"
+    print(msg, flush=True)
+    return slope
+
+
+def pack_pairs(cols, pairs):
+    """Pack word-index pairs of ``cols [W, N]`` into u64 rows.
+
+    Each (hi, lo) pair becomes one u64 with ``hi`` in the high bits, so
+    u64 ascending order == (hi, lo) lexicographic ascending.
+    """
+    outs = []
+    for hi, lo in pairs:
+        two = jnp.stack([cols[lo], cols[hi]], axis=-1)  # little-endian
+        outs.append(lax.bitcast_convert_type(two, jnp.uint64))
+    return outs
+
+
+def unpack_pairs(packed):
+    """Inverse of pack_pairs: u64 [N] -> (hi u32 [N], lo u32 [N])."""
+    outs = []
+    for p in packed:
+        two = lax.bitcast_convert_type(p, jnp.uint32)    # [N, 2]
+        outs.append((two[:, 1], two[:, 0]))
+    return outs
+
+
+def case_tail100(rng):
+    from sparkrdma_tpu.kernels.wide_sort import apply_perm, sort_wide_cols
+
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    def full(c):
+        return sort_wide_cols(c, KW, None, ride_words=10)
+
+    def sort_only(c):
+        idx = lax.iota(jnp.int32, N)
+        ops = tuple(c[i] for i in range(KW + 10)) + (idx,)
+        out = lax.sort(ops, num_keys=KW, is_stable=True)
+        return jnp.stack(out[:-1] + (out[-1].astype(jnp.uint32),))
+
+    def gather_only(c):
+        # pseudo-perm derived from the data (can't precompute: perturb
+        # changes it) — xor-fold words to an in-range index
+        perm = (c[0] ^ c[12]) % jnp.uint32(N)
+        return apply_perm(c[KW + 10:].T, perm.astype(jnp.int32)).T
+
+    time_op("full sort_wide_cols ride=10 (W=25)", full, cols,
+            bytes_moved=N * 100)
+    time_op("  sort-only 13 ops (2key+10+idx)", sort_only, cols)
+    time_op("  gather-only 13 words", gather_only, cols)
+
+
+def case_ride(rng):
+    from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
+
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+    for r in (0, 5, 8, 13):
+        time_op(f"sort_wide_cols ride={r}",
+                lambda c, r=r: sort_wide_cols(c, KW, None, ride_words=r),
+                cols, bytes_moved=N * 100)
+
+
+def case_packmono(rng):
+    jax.config.update("jax_enable_x64", True)
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    def packed(c):
+        # 1 u64 key + 11 u64 payload pairs + 1 u32 leftover = 13 operands
+        key = pack_pairs(c, [(0, 1)])[0]
+        vals = pack_pairs(c, [(2 * i + 2, 2 * i + 3) for i in range(11)])
+        out = lax.sort((key,) + tuple(vals) + (c[24],), num_keys=1,
+                       is_stable=False)
+        rows = []
+        for hi, lo in unpack_pairs(out[:-1]):
+            rows += [hi, lo]
+        rows.append(out[-1])
+        return jnp.stack(rows)
+
+    time_op("PACKED monolithic 13 ops (100B rides)", packed, cols,
+            bytes_moved=N * 100)
+
+
+def case_packwide(rng):
+    jax.config.update("jax_enable_x64", True)
+    from sparkrdma_tpu.kernels.wide_sort import apply_perm
+
+    cols = jax.device_put(
+        rng.integers(0, 2**32, size=(W, N), dtype=np.uint32))
+    barrier(cols)
+
+    def packed_wide(c, rp):
+        key = pack_pairs(c, [(0, 1)])[0]
+        rides = pack_pairs(c, [(2 * i + 2, 2 * i + 3) for i in range(rp)])
+        idx = lax.iota(jnp.int32, N)
+        out = lax.sort((key,) + tuple(rides) + (idx,), num_keys=1,
+                       is_stable=True)
+        rows = []
+        for hi, lo in unpack_pairs(out[:1] + out[1:-1]):
+            rows += [hi, lo]
+        perm = out[-1]
+        placed = apply_perm(c[2 + 2 * rp:].T, perm).T
+        return jnp.concatenate([jnp.stack(rows), placed], axis=0)
+
+    for rp in (3, 5):
+        time_op(f"PACKED wide: u64 key + {rp} u64 rides + idx",
+                lambda c, rp=rp: packed_wide(c, rp), cols,
+                bytes_moved=N * 100)
+
+
+def case_x64check(rng):
+    """Parity: packed monolithic == lexsort_cols on the key words."""
+    jax.config.update("jax_enable_x64", True)
+    n = 1 << 12
+    cols = rng.integers(0, 2**32, size=(W, n), dtype=np.uint32)
+    # duplicate some keys to exercise tie behavior
+    cols[:KW, : n // 4] = cols[:KW, n // 4: n // 2]
+    x = jax.device_put(cols)
+
+    def packed(c):
+        key = pack_pairs(c, [(0, 1)])[0]
+        vals = pack_pairs(c, [(2 * i + 2, 2 * i + 3) for i in range(11)])
+        out = lax.sort((key,) + tuple(vals) + (c[24],), num_keys=1,
+                       is_stable=False)
+        rows = []
+        for hi, lo in unpack_pairs(out[:-1]):
+            rows += [hi, lo]
+        rows.append(out[-1])
+        return jnp.stack(rows)
+
+    got = np.asarray(jax.jit(packed)(x))
+    # reference: numpy lexsort by (hi, lo), full-record canonical order
+    def canon(a):
+        return a[:, np.lexsort(tuple(a[c] for c in range(a.shape[0] - 1,
+                                                         -1, -1)))]
+    ref = cols[:, np.lexsort((cols[1], cols[0]))]
+    # keys must match exactly; full records as multisets per key group
+    assert np.array_equal(np.sort(got[0]), np.sort(ref[0]))
+    assert np.array_equal(canon(got), canon(cols))
+    ks = got[0].astype(np.uint64) << np.uint64(32) | got[1]
+    assert np.all(ks[1:] >= ks[:-1])
+    print("x64check PASS: packed sort is key-ordered and a permutation",
+          flush=True)
+
+
+def main():
+    case = os.environ.get("PROF_CASE", "tail100")
+    print(f"platform={jax.devices()[0].platform} N={N} case={case} "
+          f"cache={'on' if cache_dir else 'off'}", flush=True)
+    rng = np.random.default_rng(0)
+    {"tail100": case_tail100, "ride": case_ride,
+     "packmono": case_packmono, "packwide": case_packwide,
+     "x64check": case_x64check}[case](rng)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

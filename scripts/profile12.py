@@ -1,0 +1,110 @@
+"""Same-process A/B: u64-packed monolithic sort vs the ride/gather wide
+path vs unpacked monolithic, at both bench widths.
+
+Chip numbers drift ±10-15% across sessions (verify skill), so every
+candidate is timed in THIS process with the identical harness
+(single-program timing, min of 5 post-warm runs; the per-dispatch/sync
+overhead is identical across candidates and cancels in the comparison —
+absolute GB/s claims come from bench.py, not from here).
+
+Candidates (all full-record key sorts, kw=2, the fused-tail shape):
+  W=13: mono13 (13 u32 operands) vs packed13 (7 operands: 1 u64 key +
+        5 u64 + 1 u32 payload)
+  W=25: wide25 (ride=10 + 13-word gather) vs mono25 (25 operands) vs
+        packed25 (13 operands)
+  bucket25: map-side shape — 1 u32 pid key + 25 words riding:
+        unpacked (26 ops) vs packed (14 ops)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+cache_dir = os.environ.get("PROF_CACHE_DIR")
+
+import jax
+
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.kernels.sort import lexsort_cols, packed_lexsort_cols
+from sparkrdma_tpu.kernels.wide_sort import sort_wide_cols
+from sparkrdma_tpu.utils.stats import barrier
+
+N = int(os.environ.get("PROF_RECORDS", 16 * 1024 * 1024))
+
+
+def time_one(name, fn, x, bytes_moved):
+    g = jax.jit(fn)
+    t0 = time.perf_counter()
+    barrier(g(x))
+    compile_s = time.perf_counter() - t0
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        barrier(g(x))
+        ts.append(time.perf_counter() - t0)
+    best = min(ts)
+    print(f"{name:40s} {best*1e3:8.2f} ms  = "
+          f"{bytes_moved / best / 1e9:6.2f} GB/s  "
+          f"(spread {min(ts)*1e3:.0f}-{max(ts)*1e3:.0f}, "
+          f"compile+first {compile_s:.1f}s)", flush=True)
+    return best
+
+
+def main():
+    case = os.environ.get("PROF_CASE", "w13")
+    print(f"platform={jax.devices()[0].platform} N={N} case={case}",
+          flush=True)
+    rng = np.random.default_rng(0)
+
+    if case == "w13":
+        cols = jax.device_put(
+            rng.integers(0, 2**32, size=(13, N), dtype=np.uint32))
+        barrier(cols)
+        time_one("mono13 (13 u32 ops)",
+                 lambda c: lexsort_cols(c, 2, stable=False), cols, N * 52)
+        time_one("packed13 (7 ops)",
+                 lambda c: packed_lexsort_cols(c, 2), cols, N * 52)
+    elif case == "w25":
+        cols = jax.device_put(
+            rng.integers(0, 2**32, size=(25, N), dtype=np.uint32))
+        barrier(cols)
+        time_one("wide25 ride=10 + gather13",
+                 lambda c: sort_wide_cols(c, 2, None, ride_words=10),
+                 cols, N * 100)
+        time_one("packed25 (13 ops)",
+                 lambda c: packed_lexsort_cols(c, 2), cols, N * 100)
+        time_one("mono25 (25 u32 ops)",
+                 lambda c: lexsort_cols(c, 2, stable=False), cols, N * 100)
+    elif case == "bucket25":
+        cols = np.zeros((26, N), dtype=np.uint32)
+        cols[0] = rng.integers(0, 8, size=N)       # pid
+        cols[1:] = rng.integers(0, 2**32, size=(25, N), dtype=np.uint32)
+        cols = jax.device_put(cols)
+        barrier(cols)
+        time_one("bucket packed (1 pid + 12 u64 + u32)",
+                 lambda c: packed_lexsort_cols(c, 1, stable=True),
+                 cols, N * 104)
+        time_one("bucket wide (pid+10 ride+idx, gather)",
+                 lambda c: jnp.concatenate([
+                     c[:1] * 0,  # placeholder row to keep shapes equal
+                     __import__("sparkrdma_tpu.kernels.bucketing",
+                                fromlist=["bucket_records"]
+                                ).bucket_records(
+                         c[1:], c[0], 8, wide=True, ride_words=10)[0]]),
+                 cols, N * 104)
+    else:
+        raise SystemExit(f"unknown case {case}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

