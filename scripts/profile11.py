@@ -131,9 +131,13 @@ def case_tail100(rng):
 
     def gather_only(c):
         # pseudo-perm derived from the data (can't precompute: perturb
-        # changes it) — xor-fold words to an in-range index
+        # changes it) — xor-fold words to an in-range index. Output is
+        # padded back to W rows so CHAINED timing (k=3) keeps gathering
+        # 13 words every iteration (a 13-row output would make rounds
+        # 2-3 gather one word and wreck the slope — review finding).
         perm = (c[0] ^ c[12]) % jnp.uint32(N)
-        return apply_perm(c[KW + 10:].T, perm.astype(jnp.int32)).T
+        placed = apply_perm(c[KW + 10:].T, perm.astype(jnp.int32)).T
+        return jnp.concatenate([c[:KW + 10], placed], axis=0)
 
     time_op("full sort_wide_cols ride=10 (W=25)", full, cols,
             bytes_moved=N * 100)
@@ -190,7 +194,7 @@ def case_packwide(rng):
         out = lax.sort((key,) + tuple(rides) + (idx,), num_keys=1,
                        is_stable=True)
         rows = []
-        for hi, lo in unpack_pairs(out[:1] + out[1:-1]):
+        for hi, lo in unpack_pairs(out[:-1]):
             rows += [hi, lo]
         perm = out[-1]
         placed = apply_perm(c[2 + 2 * rp:].T, perm).T

@@ -1,10 +1,12 @@
 """On-chip verification sweep: every BASELINE workload family runs its
 numpy-reference check on the real TPU (not just the CPU test mesh).
 
-Round-4 re-run: the aggregator was rewritten scatter-free and the
-wide-record paths landed since the round-3 sweep; this proves the
-families (BASELINE.md configs 1-5) still verify on hardware, plus the
-100-byte wide-record terasort end to end.
+Round-5 sweep: the sort strategies were rebuilt (u64 packing + the
+sort_mode selector), ranged reads learned skew-split plans, and the
+Dataset layer grew groupByKey/cogroup + serde-encoded records — so the
+sweep re-proves the BASELINE families (configs 1-5) AND the new verbs
+on hardware: the 100-byte terasort, a serde-encoded shuffle with
+payload round-trip, and grouped-values materialization.
 """
 
 import os
@@ -100,6 +102,43 @@ def main() -> int:
         results["terasort_100B"] = t.verified
     finally:
         mw.stop()
+
+    # serde-encoded records through a real shuffle (byte payloads
+    # round-trip the exchange — SURVEY §3.3's deserialize stage)
+    from sparkrdma_tpu.api.dataset import Dataset
+    from sparkrdma_tpu.api.serde import (decode_bytes_rows,
+                                         encode_bytes_rows)
+
+    sconf = ShuffleConf(slot_records=1 << 13, val_words=1 + 6)
+    ms = ShuffleManager(MeshRuntime(sconf), sconf)
+    try:
+        n = 1 << 13
+        keys = rng.integers(0, 2**31, size=(n, 2), dtype=np.uint32)
+        lens = rng.integers(0, 25, size=n)
+        payloads = [bytes(rng.integers(0, 256, size=int(ln),
+                                       dtype=np.uint8)) for ln in lens]
+        rows = encode_bytes_rows(keys, payloads, 24)
+        back = Dataset.from_host_rows(ms, rows).repartition() \
+            .to_host_rows()
+        k2, p2 = decode_bytes_rows(back, 2)
+        ref = {tuple(map(int, keys[i])): payloads[i] for i in range(n)}
+        got = {tuple(map(int, k2[i])): p2[i] for i in range(n)}
+        results["serde_shuffle"] = (got == ref)
+
+        # grouped-values on chip (groupByKey CSR pair)
+        xg = np.zeros((n, 4), dtype=np.uint32)
+        xg[:, 1] = rng.integers(0, 64, size=n)
+        xg[:, 2] = rng.integers(0, 2**31, size=n)
+        gconf_ds = Dataset.from_host_rows(ms, xg)
+        g = gconf_ds.group_by_key()
+        grouped = g.to_host()
+        ref_counts = {}
+        for k in xg[:, 1]:
+            ref_counts[(0, int(k))] = ref_counts.get((0, int(k)), 0) + 1
+        results["group_by_key"] = (
+            {k: v.shape[0] for k, v in grouped.items()} == ref_counts)
+    finally:
+        ms.stop()
 
     elapsed = time.perf_counter() - t0
     ok = all(bool(vv) for vv in results.values())
