@@ -45,10 +45,31 @@ import os
 import sys
 
 
+def _bench_metrics(manager) -> dict:
+    """Fold the run's observability into the bench JSON: exchange rounds,
+    per-peer skew of the recorded read, pool occupancy high-water."""
+    recs = manager.stats.records
+    skew = 1.0
+    if recs:
+        per = recs[-1].per_source_records
+        mean = float(per.mean()) if len(per) else 0.0
+        if mean > 0:
+            skew = float(per.max()) / mean
+    pool = manager.runtime.pool
+    return {
+        "exchanges": len(recs),
+        "rounds": sum(r.num_rounds for r in recs),
+        "per_peer_skew": round(skew, 3),
+        "pool_high_water": (pool.outstanding_high_water
+                            if pool is not None else 0),
+    }
+
+
 def run_width(record_words: int, records_per_device: int,
-              repeats: int) -> float:
-    """One full bench leg at ``record_words``; returns GB/s per chip
-    (negative on verification failure)."""
+              repeats: int):
+    """One full bench leg at ``record_words``; returns ``(gbps, metrics)``
+    — GB/s per chip (negative on verification failure) plus the
+    observability summary embedded in the bench JSON."""
     import jax
 
     from sparkrdma_tpu import MeshRuntime, ShuffleConf
@@ -81,7 +102,11 @@ def run_width(record_words: int, records_per_device: int,
                        # stable geometry across repeats: tight classes
                        # beat pow2 padding (matters on >1-chip meshes)
                        geometry_classes="fine",
-                       collect_shuffle_read_stats=False, **kw)
+                       # stats ride only the FINAL (recorded) read — the
+                       # timed loop issues record_stats=False reads, so
+                       # the throughput number is untouched while the
+                       # bench JSON still carries rounds/skew/pool data
+                       collect_shuffle_read_stats=True, **kw)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
         res, _, _ = run_terasort(
@@ -93,9 +118,10 @@ def run_width(record_words: int, records_per_device: int,
             repeats=repeats,
             shuffle_id=0,
         )
+        metrics = _bench_metrics(manager)
         if not res.verified:
-            return -1.0
-        return res.gbps / mesh_size
+            return -1.0, metrics
+        return res.gbps / mesh_size, metrics
     finally:
         manager.stop()
 
@@ -128,7 +154,8 @@ def main() -> int:
     baseline_gbps = 12.5  # 100Gb/s RoCE per node, BASELINE.md
 
     if explicit_words:
-        gbps = run_width(int(explicit_words), records_per_device, repeats)
+        gbps, metrics = run_width(int(explicit_words), records_per_device,
+                                  repeats)
         if gbps < 0:
             print(json.dumps({"error": "device verification FAILED"}))
             return 1
@@ -138,16 +165,17 @@ def main() -> int:
             "unit": "GB/s/chip",
             "vs_baseline": round(gbps / baseline_gbps, 3),
             "record_bytes": int(explicit_words) * 4,
+            "metrics": metrics,
         }))
         return 0
 
     # faithful HiBench width (100B) is the judged number; the width-curve
     # optimum (52B) is reported alongside, labeled
-    faithful = run_width(25, records_per_device, repeats)
+    faithful, metrics = run_width(25, records_per_device, repeats)
     if faithful < 0:   # fail fast: don't spend the second leg's minutes
         print(json.dumps({"error": "device verification FAILED"}))
         return 1
-    optimal = run_width(13, records_per_device, repeats)
+    optimal, _ = run_width(13, records_per_device, repeats)
     if optimal < 0:
         print(json.dumps({"error": "device verification FAILED"}))
         return 1
@@ -159,6 +187,7 @@ def main() -> int:
         "record_bytes": 100,
         "value_width_optimal": round(optimal, 3),
         "width_optimal_record_bytes": 52,
+        "metrics": metrics,   # the faithful (judged) leg's observability
     }))
     return 0
 

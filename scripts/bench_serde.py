@@ -40,6 +40,7 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np
 
+from bench import _bench_metrics
 from sparkrdma_tpu import MeshRuntime, ShuffleConf
 from sparkrdma_tpu.api.serde import (decode_bytes_rows, encode_bytes_rows,
                                      payload_words)
@@ -84,7 +85,10 @@ def main() -> int:
 
     conf = ShuffleConf(slot_records=max(4096, n), max_rounds=64,
                        max_slot_records=max(1 << 22, 2 * n),
-                       val_words=w - 2, geometry_classes="fine")
+                       val_words=w - 2, geometry_classes="fine",
+                       # stats ride only the final recorded read; the
+                       # timed loop stays record_stats=False (see bench.py)
+                       collect_shuffle_read_stats=True)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
         records = manager.runtime.shard_records(rows)
@@ -121,6 +125,7 @@ def main() -> int:
             "payload": "variable 0-92B, mean ~46B",
             "host_encode_mbps": round(n * w * 4 / encode_s / 1e6, 1),
             "decoded_rows_verified": checked,
+            "metrics": _bench_metrics(manager),
         }))
         return 0
     finally:

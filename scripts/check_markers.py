@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Test-hygiene lint, run at the top of the tier-1 command (ROADMAP.md).
+
+Two invariants keep the CPU tier-1 suite honest:
+
+1. **Importability** — every ``tests/test_*.py`` must import cleanly
+   under ``JAX_PLATFORMS=cpu``. A module that dies at import time makes
+   pytest report a collection error; with ``--continue-on-collection-
+   errors`` the rest of the suite still runs and the dead module's tests
+   silently stop counting. This check turns that silent shrinkage into a
+   loud failure listing the module and the exception.
+2. **Slow markers** — any test module that launches worker subprocesses
+   (``tests/mp_worker.py`` or the ``subprocess`` module) must carry at
+   least one ``pytest.mark.slow``, so ``-m 'not slow'`` actually excludes
+   the multi-process tests it promises to exclude.
+
+Static checks only read source; the import check executes module tops,
+which for this suite is cheap (heavy work lives inside test bodies).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import traceback
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TESTS = REPO / "tests"
+
+
+def check_importable(path: Path) -> str:
+    """Import one test module in-process; return an error string or ''."""
+    name = f"_marker_check_{path.stem}"
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        # conftest defines fixtures, not imports, so plain module exec
+        # reproduces pytest's collection-time import faithfully
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return ""
+    except BaseException:
+        return traceback.format_exc(limit=3)
+    finally:
+        sys.modules.pop(name, None)
+
+
+def check_slow_marked(path: Path) -> str:
+    """Subprocess-launching modules must mark slow; '' if compliant."""
+    src = path.read_text(encoding="utf-8")
+    launches = ("mp_worker" in src
+                or "subprocess.Popen" in src or "subprocess.run" in src)
+    if launches and "pytest.mark.slow" not in src:
+        return (f"{path.name} launches subprocesses but has no "
+                "pytest.mark.slow marker — it would run under "
+                "-m 'not slow'")
+    return ""
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO))
+    failures = []
+    modules = sorted(TESTS.glob("test_*.py"))
+    if not modules:
+        print("check_markers: no test modules found", file=sys.stderr)
+        return 1
+    for path in modules:
+        err = check_slow_marked(path)
+        if err:
+            failures.append(("slow-marker", path.name, err))
+        err = check_importable(path)
+        if err:
+            failures.append(("import", path.name, err))
+    if failures:
+        print(f"check_markers: {len(failures)} failure(s)", file=sys.stderr)
+        for kind, name, err in failures:
+            print(f"--- [{kind}] {name}\n{err}", file=sys.stderr)
+        return 1
+    print(f"check_markers: {len(modules)} test modules importable, "
+          "slow markers consistent")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

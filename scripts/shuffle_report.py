@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Aggregate an exchange journal into per-peer / per-phase summaries.
+
+The journal (``ShuffleConf.metrics_sink``) holds one JSON line per
+executed shuffle read — see ``sparkrdma_tpu/obs/journal.py`` for the
+schema. This CLI answers the questions the reference answered by
+grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
+
+- per-phase time: where do reads spend their wall-clock
+  (plan / exchange / sort), overall and per shuffle;
+- per-peer receive table: records contributed by each source device,
+  summed across spans — the ``printRemoteFetchHistogram`` table;
+- skew report: max/mean per-peer ratio per span, worst offenders first;
+- pressure: slot-pool occupancy high-water, spill count, retries.
+
+Stdlib only (no jax / numpy): runs anywhere the journal file lands,
+including hosts with no accelerator stack installed.
+
+Usage::
+
+    python scripts/shuffle_report.py /path/to/journal.jsonl
+    python scripts/shuffle_report.py journal.jsonl --json   # machine form
+    python scripts/shuffle_report.py journal.jsonl --top 5  # worst skew
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_spans(path: str) -> List[dict]:
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"warning: {path}:{ln}: bad JSON line skipped ({e})",
+                      file=sys.stderr)
+    return spans
+
+
+def span_skew(span: dict) -> float:
+    """Max/mean ratio of the per-peer receive table (1.0 = balanced)."""
+    peers = span.get("per_peer_records") or []
+    if not peers:
+        return 1.0
+    mean = sum(peers) / len(peers)
+    if mean <= 0:
+        return 1.0
+    return max(peers) / mean
+
+
+def aggregate(spans: List[dict]) -> dict:
+    """Fold a journal into the report dict (the --json payload)."""
+    if not spans:
+        return {"spans": 0}
+    phases = {"plan_s": 0.0, "exchange_s": 0.0, "sort_s": 0.0}
+    per_peer: Dict[int, int] = {}
+    per_shuffle: Dict[int, dict] = {}
+    total_records = 0
+    total_bytes = 0
+    rounds = 0
+    dispatches = 0
+    retries = 0
+    pool_high_water = 0
+    spills = 0
+    for s in spans:
+        for k in phases:
+            phases[k] += float(s.get(k, 0.0))
+        for i, c in enumerate(s.get("per_peer_records") or []):
+            per_peer[i] = per_peer.get(i, 0) + int(c)
+        total_records += int(s.get("records", 0))
+        total_bytes += int(s.get("total_bytes",
+                                 s.get("records", 0)
+                                 * s.get("record_bytes", 0)))
+        rounds += int(s.get("rounds", 0))
+        dispatches += int(s.get("dispatches", 0))
+        retries += int(s.get("retry_count", 0))
+        pool_high_water = max(pool_high_water,
+                              int(s.get("pool_high_water", 0)))
+        spills = max(spills, int(s.get("spill_count", 0)))
+        sid = int(s.get("shuffle_id", -1))
+        agg = per_shuffle.setdefault(sid, {
+            "spans": 0, "records": 0, "rounds": 0,
+            "plan_s": 0.0, "exchange_s": 0.0, "sort_s": 0.0,
+            "max_skew": 1.0,
+        })
+        agg["spans"] += 1
+        agg["records"] += int(s.get("records", 0))
+        agg["rounds"] += int(s.get("rounds", 0))
+        for k in ("plan_s", "exchange_s", "sort_s"):
+            agg[k] += float(s.get(k, 0.0))
+        agg["max_skew"] = max(agg["max_skew"], span_skew(s))
+    skews = sorted(
+        ({"span_id": s.get("span_id"), "shuffle_id": s.get("shuffle_id"),
+          "skew": round(span_skew(s), 3),
+          "per_peer_records": s.get("per_peer_records")}
+         for s in spans),
+        key=lambda d: d["skew"], reverse=True)
+    wall = sum(phases.values())
+    return {
+        "spans": len(spans),
+        "shuffles": len(per_shuffle),
+        "total_records": total_records,
+        "total_bytes": total_bytes,
+        "rounds": rounds,
+        "dispatches": dispatches,
+        "retries": retries,
+        "pool_high_water": pool_high_water,
+        "spill_count": spills,
+        "phases": {k: round(v, 6) for k, v in phases.items()},
+        "phase_share": {
+            k: round(v / wall, 4) if wall > 0 else 0.0
+            for k, v in phases.items()},
+        "per_peer_records": {str(k): per_peer[k] for k in sorted(per_peer)},
+        "per_shuffle": {
+            str(k): {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                     for kk, vv in v.items()}
+            for k, v in sorted(per_shuffle.items())},
+        "skew": skews,
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+def print_report(rep: dict, top: int) -> None:
+    if not rep.get("spans"):
+        print("journal is empty: no exchange spans recorded")
+        return
+    print(f"exchange journal report — {rep['spans']} spans across "
+          f"{rep['shuffles']} shuffles")
+    print(f"  records: {rep['total_records']:,}   "
+          f"bytes: {_fmt_bytes(rep['total_bytes'])}   "
+          f"rounds: {rep['rounds']}   dispatches: {rep['dispatches']}")
+    print(f"  retries: {rep['retries']}   "
+          f"pool high-water: {rep['pool_high_water']}   "
+          f"spills: {rep['spill_count']}")
+    print("per-phase wall-clock:")
+    for k, v in rep["phases"].items():
+        share = rep["phase_share"][k]
+        print(f"  {k:<11} {v:>10.4f}s  {share:>6.1%}")
+    print("per-peer received records (all spans):")
+    peers = rep["per_peer_records"]
+    total = sum(peers.values()) or 1
+    for peer, cnt in peers.items():
+        print(f"  peer {peer:>3}: {cnt:>12,}  {cnt / total:>6.1%}")
+    print("per-shuffle:")
+    for sid, agg in rep["per_shuffle"].items():
+        print(f"  shuffle {sid}: {agg['spans']} spans, "
+              f"{agg['records']:,} records, {agg['rounds']} rounds, "
+              f"exchange {agg['exchange_s']:.4f}s, "
+              f"max skew {agg['max_skew']:.2f}x")
+    worst = [s for s in rep["skew"][:top] if s["skew"] > 1.0]
+    if worst:
+        print(f"skew report (worst {len(worst)} spans, max/mean per peer):")
+        for s in worst:
+            print(f"  span {s['span_id']} (shuffle {s['shuffle_id']}): "
+                  f"{s['skew']:.2f}x  peers={s['per_peer_records']}")
+    else:
+        print("skew report: all spans balanced (max/mean = 1.0)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Aggregate a sparkrdma_tpu exchange journal")
+    ap.add_argument("journal", help="JSON-lines journal file "
+                    "(ShuffleConf.metrics_sink)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
+    ap.add_argument("--top", type=int, default=3,
+                    help="spans to list in the skew report (default 3)")
+    args = ap.parse_args(argv)
+    spans = load_spans(args.journal)
+    rep = aggregate(spans)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rep, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # e.g. piped into head
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -46,8 +46,10 @@ from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
 from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
+from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
-from sparkrdma_tpu.utils.profiling import annotate
+from sparkrdma_tpu.utils.profiling import annotate, annotate_span
 from sparkrdma_tpu.utils.stats import (ExchangeRecord, ShuffleReadStats,
                                        Timer, barrier)
 
@@ -206,6 +208,12 @@ class ShuffleReader:
         writer = self._m._recover_writer(self._h)
         ex = self._m._exchange
         conf = self._m.conf
+        # one journal span per read() call (not per attempt — retries are
+        # a field of the span, not separate spans); its id also names the
+        # XProf annotations so trace regions and journal lines correlate
+        journal_on = self._m.journal.enabled and record_stats
+        span_id = next_span_id() if journal_on else 0
+        post_s = 0.0   # separate filter/agg/sort program wall-clock
         attempt = 0
         while True:
             attempt += 1
@@ -222,7 +230,7 @@ class ShuffleReader:
                 fuse_agg = (self.aggregator or "") if not filtered else ""
                 with Timer() as t:
                     try:
-                        with annotate("shuffle:exchange"):
+                        with annotate_span("shuffle:exchange", span_id):
                             out, totals, incoming = ex.exchange(
                                 writer.records, self._h.partitioner,
                                 writer.plan, self._h.num_parts,
@@ -234,7 +242,8 @@ class ShuffleReader:
                                                if fuse_agg else False),
                             )
                         if filtered:
-                            with annotate("shuffle:filter+agg+sort"):
+                            with Timer() as ts, annotate_span(
+                                    "shuffle:filter+agg+sort", span_id):
                                 if writer.plan.split_factor > 1:
                                     # sub-partition segments of a parent
                                     # are scattered through the stream;
@@ -260,6 +269,10 @@ class ShuffleReader:
                                 elif self.key_ordering:
                                     out = self._m._sorted(out, totals,
                                                           writer.plan)
+                            # dispatch wall-clock of the separate
+                            # filter/agg/sort programs; 0.0 when those
+                            # stages are fused into the exchange program
+                            post_s = ts.elapsed
                         if record_stats:
                             # the hard sync exists to time exec_s and to
                             # surface device failures inside the retry
@@ -297,15 +310,40 @@ class ShuffleReader:
         if record_stats:
             # per-source totals for the histogram (received metadata table)
             per_source = plan.counts.sum(axis=1)
+            plan_s = self._m._plan_seconds.get(self._h.shuffle_id, 0.0)
             self._m.stats.add(ExchangeRecord(
                 shuffle_id=self._h.shuffle_id,
-                plan_s=self._m._plan_seconds.get(self._h.shuffle_id, 0.0),
+                plan_s=plan_s,
                 exec_s=t.elapsed,
                 total_records=plan.total_records,
                 record_bytes=out.shape[0] * 4,
                 num_rounds=plan.num_rounds,
                 per_source_records=per_source,
             ))
+            if journal_on:
+                from sparkrdma_tpu.hbm.host_staging import spill_count
+
+                pool = self._m.runtime.pool
+                self._m.journal.emit(ExchangeSpan(
+                    span_id=span_id,
+                    shuffle_id=self._h.shuffle_id,
+                    transport=self._m.conf.transport,
+                    rounds=plan.num_rounds,
+                    dispatches=ex.last_dispatches,
+                    records=plan.total_records,
+                    record_bytes=out.shape[0] * 4,
+                    plan_s=plan_s,
+                    # t covers the whole attempt through the hard sync;
+                    # the separate filter/agg/sort block is reported on
+                    # its own (sort_s), so subtract its dispatch time
+                    exchange_s=max(t.elapsed - post_s, 0.0),
+                    sort_s=post_s,
+                    per_peer_records=[int(c) for c in per_source],
+                    pool_high_water=(pool.outstanding_high_water
+                                     if pool is not None else 0),
+                    spill_count=spill_count(),
+                    retry_count=attempt - 1,
+                ))
         del incoming
         return out, totals
 
@@ -441,17 +479,29 @@ class ShuffleManager:
                 compression=self.conf.compression,
                 compression_level=self.conf.compression_level)
         self.store = store
+        # unified observability root: either knob turns the registry on
+        # (collect_shuffle_read_stats for in-memory stats, metrics_sink
+        # for the journal); off, every instrument is a shared no-op
+        self.metrics = MetricsRegistry(
+            enabled=(self.conf.collect_shuffle_read_stats
+                     or bool(self.conf.metrics_sink)))
+        self.journal = ExchangeJournal(self.conf.metrics_sink)
         # the runtime's SlotPool serves exchange recv/output buffers
         # (RdmaBufferManager wiring: the node owns the pool, channels use it)
+        if self.runtime.pool is not None:
+            self.runtime.pool.metrics = self.metrics
+        self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats,
+                                      registry=self.metrics)
         self._exchange = ShuffleExchange(self.runtime.mesh,
                                          self.runtime.axis_name, self.conf,
-                                         pool=self.runtime.pool)
+                                         pool=self.runtime.pool,
+                                         metrics=self.metrics,
+                                         stats=self.stats)
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
-        self._registry = MapOutputRegistry(ids)
+        self._registry = MapOutputRegistry(ids, metrics=self.metrics)
         self._writers: dict[int, ShuffleWriter] = {}
         self._plan_seconds: dict[int, float] = {}
-        self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats)
         self._sort_cache: dict[tuple, Callable] = {}
         self._filter_cache: dict[tuple, Callable] = {}
 
@@ -600,6 +650,7 @@ class ShuffleManager:
     def stop(self) -> None:
         if self.stats.enabled and self.stats.records:
             self.stats.print_histogram()
+        self.journal.close()
         self._writers.clear()
         self.runtime.stop()
 

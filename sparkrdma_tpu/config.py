@@ -19,7 +19,10 @@ bytes-in-flight throttle         ``max_rounds_in_flight`` — how many exchange
  ("size:count,...")              warm slot-pool classes.
 ``recvQueueDepth`` /             ``queue_depth`` — reader result-queue bound
 ``sendQueueDepth``               (completed slots awaiting consumption).
-``collectShuffleReadStats``      ``collect_shuffle_read_stats``
+``collectShuffleReadStats``      ``collect_shuffle_read_stats``; the
+                                 machine-readable superset is
+                                 ``metrics_sink`` — a JSON-lines exchange
+                                 journal (sparkrdma_tpu.obs).
 ``maxConnectionAttempts``        ``max_retry_attempts`` — job-level retries
                                  from persisted map outputs.
 ``useOdp``                       dropped (no MR registration on TPU); the
@@ -175,6 +178,12 @@ class ShuffleConf:
 
     # --- observability ---
     collect_shuffle_read_stats: bool = False
+    #: exchange-journal sink: a filesystem path receiving one JSON line
+    #: per executed shuffle read (schema: sparkrdma_tpu.obs.journal).
+    #: Empty = journal off. Enabling the journal also enables the
+    #: metrics registry, independent of collect_shuffle_read_stats.
+    #: Aggregate offline with ``python scripts/shuffle_report.py <sink>``.
+    metrics_sink: str = ""
 
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue

@@ -54,9 +54,21 @@ def hierarchy_for(mesh, axis_name: str, hosts: int = 0) -> int:
 
 
 def make_hierarchical_all_to_all(mesh, axis_name: str,
-                                 hosts: int = 0) -> Callable:
+                                 hosts: int = 0,
+                                 metrics=None) -> Callable:
     """Build the two-stage a2a with the flat transport's contract:
-    dest-major ``[mesh, ...]`` in, source-major ``[mesh, ...]`` out."""
+    dest-major ``[mesh, ...]`` in, source-major ``[mesh, ...]`` out.
+
+    ``metrics`` (a :class:`~sparkrdma_tpu.obs.metrics.MetricsRegistry`)
+    counts collective instances as programs trace them — trace-time
+    counts, i.e. how many staged exchanges were embedded into compiled
+    programs, not per-execution counts (executions happen on device,
+    invisible to host counters).
+    """
+    from sparkrdma_tpu.obs.metrics import MetricsRegistry
+
+    if metrics is None:
+        metrics = MetricsRegistry(enabled=False)
     size = int(mesh.shape[axis_name])
     h = hierarchy_for(mesh, axis_name, hosts)
     local = size // h
@@ -64,6 +76,7 @@ def make_hierarchical_all_to_all(mesh, axis_name: str,
         # degenerate hierarchy: one host or one device per host — the
         # flat exchange IS the correct algorithm
         def flat(slots):
+            metrics.counter("transport.hier.flat_fallbacks").inc()
             return lax.all_to_all(slots, axis_name, split_axis=0,
                                   concat_axis=0, tiled=True)
         return flat
@@ -72,6 +85,7 @@ def make_hierarchical_all_to_all(mesh, axis_name: str,
     inter = [[hh * local + ll for hh in range(h)] for ll in range(local)]
 
     def a2a(slots: jax.Array) -> jax.Array:
+        metrics.counter("transport.hier.staged_exchanges").inc()
         # slots: [size, ...] dest-major (entry d' bound for device d')
         rest = slots.shape[1:]
         x = slots.reshape((h, local) + rest)       # [h', l', ...]

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -73,6 +74,8 @@ from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
                                              fill_round_slots,
                                              histogram_pids)
 
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
 from sparkrdma_tpu.utils.compat import shard_map
 
 
@@ -183,12 +186,28 @@ class ShuffleExchange:
 
     def __init__(self, mesh: Mesh, axis_name: str,
                  conf: Optional[ShuffleConf] = None,
-                 pool=None):
+                 pool=None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 stats: Optional[ShuffleReadStats] = None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
         self.mesh_size = int(mesh.shape[axis_name])
         self.pool = pool
+        # disabled registry by default: instrumentation sites stay
+        # unconditional (null instruments are no-ops)
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry(enabled=False)
+        # optional read-stats accumulator so DIRECT exchange users (the
+        # ring / hierarchical transport paths driven without a
+        # ShuffleManager) still populate ExchangeRecord spans when
+        # conf.collect_shuffle_read_stats is on; shuffle() feeds it.
+        if stats is not None:
+            self.stats = stats
+        else:
+            self.stats = ShuffleReadStats(
+                enabled=self.conf.collect_shuffle_read_stats,
+                registry=self.metrics)
         self._exec_cache: Dict[Tuple, Callable] = {}
         self._count_cache: Dict[Tuple, Callable] = {}
         # previous output per (shuffle_id, geometry), recycled as the next
@@ -207,15 +226,19 @@ class ShuffleExchange:
         # takes priority over the random ``fault_injection_rate``.
         self.fault_hook: Optional[Callable[[], bool]] = None
         self._fault_rng = np.random.default_rng(0xFA17)
+        #: wall-clock of the most recent plan() — folded into spans
+        self.last_plan_s = 0.0
 
     def _maybe_inject_fault(self, shuffle_id: int = -1) -> None:
         from sparkrdma_tpu.exchange.errors import FetchFailedError
 
         if self.fault_hook is not None:
             if self.fault_hook():
+                self.metrics.counter("exchange.faults").inc()
                 raise FetchFailedError(shuffle_id, "injected fault (hook)")
         elif self.conf.fault_injection_rate > 0.0:
             if self._fault_rng.random() < self.conf.fault_injection_rate:
+                self.metrics.counter("exchange.faults").inc()
                 raise FetchFailedError(shuffle_id, "injected fault (rate)")
 
     # ------------------------------------------------------------------
@@ -235,6 +258,7 @@ class ShuffleExchange:
         host round-trip is tiny and is exactly the reference's "read the
         map-output table before issuing READs" step.
         """
+        t0 = time.perf_counter()
         num_parts = num_parts or self.mesh_size
         explicit_capacity = capacity
         if num_parts % self.mesh_size:
@@ -305,6 +329,9 @@ class ShuffleExchange:
             [owned[d::self.mesh_size].sum() for d in range(self.mesh_size)]
         )
         out_capacity = classer(max(1, int(per_device_in.max())))
+        self.last_plan_s = time.perf_counter() - t0
+        self.metrics.counter("exchange.plans").inc()
+        self.metrics.histogram("exchange.plan_s").observe(self.last_plan_s)
         return ShufflePlan(
             counts=counts,
             num_rounds=num_rounds,
@@ -323,13 +350,15 @@ class ShuffleExchange:
         if self.conf.transport == "pallas_ring":
             from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
 
-            return make_ring_all_to_all(self.mesh, ax)
+            return make_ring_all_to_all(self.mesh, ax,
+                                        metrics=self.metrics)
         if self.conf.transport == "hierarchical":
             from sparkrdma_tpu.exchange.hierarchical import (
                 make_hierarchical_all_to_all)
 
             return make_hierarchical_all_to_all(
-                self.mesh, ax, self.conf.hierarchy_hosts)
+                self.mesh, ax, self.conf.hierarchy_hosts,
+                metrics=self.metrics)
 
         def a2a(slots):
             return lax.all_to_all(slots, ax, split_axis=0,
@@ -795,7 +824,9 @@ class ShuffleExchange:
             if len(in_flight) >= conf.queue_depth:
                 # the recvQueueDepth throttle: block on the oldest
                 # outstanding chunk before admitting a new one
+                self.metrics.counter("exchange.queue_blocks").inc()
                 jax.block_until_ready(in_flight.pop(0))
+            self.metrics.counter("exchange.stream_chunks").inc()
             recv_buf = get_buf(recv_shape, recv_sharding)
             r0 = jnp.full((1,), j * F, jnp.int32)
             recv = chunk_fn(sr, counts, offs, r0, recv_buf)
@@ -824,6 +855,7 @@ class ShuffleExchange:
             # the accumulator is free once the (dispatched) tail read it
             self.pool.put_shaped(acc, out_sharding)
         self.last_dispatches = dispatches
+        self.metrics.counter("exchange.dispatches").inc(dispatches)
         return out, totals, incoming
 
     # ------------------------------------------------------------------
@@ -881,6 +913,10 @@ class ShuffleExchange:
         if aggregator and aggregator not in ("sum", "min", "max"):
             raise ValueError(f"unsupported aggregator {aggregator!r}")
         self._maybe_inject_fault(shuffle_id)
+        m = self.metrics
+        m.counter("exchange.exchanges").inc()
+        m.counter("exchange.rounds").inc(plan.num_rounds)
+        m.counter("exchange.records").inc(plan.total_records)
         if plan.num_rounds > self.conf.max_rounds_in_flight:
             return self._exchange_streaming(
                 records, partitioner, plan, num_parts,
@@ -904,6 +940,7 @@ class ShuffleExchange:
                                   donate_out=donate, tight_out=tight)
             self._exec_cache[key] = fn
         self.last_dispatches = 1
+        m.counter("exchange.dispatches").inc()
         if donate:
             okey = (shuffle_id, key)
             sharding = NamedSharding(self.mesh, P(None, self.axis_name))
@@ -937,10 +974,36 @@ class ShuffleExchange:
         partitioner: Callable,
         num_parts: Optional[int] = None,
         capacity: Optional[int] = None,
+        shuffle_id: int = -1,
     ) -> Tuple[jax.Array, jax.Array, ShufflePlan]:
-        """plan + exchange in one call. Returns ``(out, totals, plan)``."""
+        """plan + exchange in one call. Returns ``(out, totals, plan)``.
+
+        When ``conf.collect_shuffle_read_stats`` is on, each call adds an
+        :class:`~sparkrdma_tpu.obs.stats.ExchangeRecord` to ``self.stats``
+        (timed to completion via a hard barrier) — this is the stats path
+        for exchanges driven WITHOUT a ShuffleManager, e.g. the ring /
+        hierarchical transport benches.
+        """
         plan = self.plan(records, partitioner, num_parts, capacity)
-        out, totals, _ = self.exchange(records, partitioner, plan, num_parts)
+        if not self.stats.enabled:
+            out, totals, _ = self.exchange(records, partitioner, plan,
+                                           num_parts, shuffle_id=shuffle_id)
+            return out, totals, plan
+        from sparkrdma_tpu.utils.stats import Timer, barrier
+
+        with Timer() as t:
+            out, totals, _ = self.exchange(records, partitioner, plan,
+                                           num_parts, shuffle_id=shuffle_id)
+            barrier(out, totals)
+        self.stats.add(ExchangeRecord(
+            shuffle_id=shuffle_id,
+            plan_s=self.last_plan_s,
+            exec_s=t.elapsed,
+            total_records=plan.total_records,
+            record_bytes=records.shape[0] * 4,
+            num_rounds=plan.num_rounds,
+            per_source_records=plan.counts.sum(axis=1),
+        ))
         return out, totals, plan
 
 

@@ -53,6 +53,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sparkrdma_tpu.utils.compat import shape_dtype_struct, tpu_compiler_params
+
 
 def _a2a_kernel(send_ref, recv_ref, send_sem, recv_sem, local_sem, *,
                 axis_name: str, num_devices: int, collective: bool):
@@ -108,20 +110,30 @@ def _a2a_kernel(send_ref, recv_ref, send_sem, recv_sem, local_sem, *,
 
 
 def make_ring_all_to_all(mesh, axis_name: str,
-                         collective_id: int = 7) -> Callable:
+                         collective_id: int = 7,
+                         metrics=None) -> Callable:
     """Build the per-device all-to-all callable for use under shard_map.
 
     Takes per-device slots ``[P, ...]`` (entry ``d`` destined for device
     ``d``) and returns ``[P, ...]`` where entry ``s`` is the chunk sent by
     device ``s`` — the same contract as ``lax.all_to_all(split_axis=0,
     concat_axis=0, tiled=True)`` on a dest-major slot tensor.
+
+    ``metrics`` counts embedded kernel instances at trace time (one per
+    round per compiled program) — the host-visible proxy for how much
+    work runs on this transport.
     """
+    from sparkrdma_tpu.obs.metrics import MetricsRegistry
+
+    if metrics is None:
+        metrics = MetricsRegistry(enabled=False)
     num_devices = int(mesh.shape[axis_name])
     interpret = jax.default_backend() != "tpu"
 
     def a2a(slots: jax.Array) -> jax.Array:
         if num_devices == 1:
             return slots
+        metrics.counter("transport.ring.kernels").inc()
         kernel = partial(_a2a_kernel, axis_name=axis_name,
                          num_devices=num_devices,
                          collective=not interpret)
@@ -129,14 +141,14 @@ def make_ring_all_to_all(mesh, axis_name: str,
             kernel,
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            out_shape=jax.ShapeDtypeStruct(slots.shape, slots.dtype,
-                                           vma=frozenset({axis_name})),
+            out_shape=shape_dtype_struct(slots.shape, slots.dtype,
+                                         vma=frozenset({axis_name})),
             scratch_shapes=[
                 pltpu.SemaphoreType.DMA((num_devices,)),  # send completions
                 pltpu.SemaphoreType.DMA((num_devices,)),  # recv completions
                 pltpu.SemaphoreType.DMA,                  # local copy
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 has_side_effects=True,
                 collective_id=collective_id,
             ),

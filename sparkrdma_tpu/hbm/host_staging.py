@@ -38,6 +38,28 @@ import numpy as np
 
 log = logging.getLogger("sparkrdma_tpu.staging")
 
+
+def _count_spill(nbytes: int) -> None:
+    """Record one host-staging spill in the process-wide registry.
+
+    Module-level functions and standalone SpillWriters have no manager
+    (and therefore no per-manager registry) in reach, so spills land in
+    :func:`~sparkrdma_tpu.obs.metrics.global_registry`; the SPI layer
+    folds the cumulative count into each exchange span at emit time.
+    """
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    reg = global_registry()
+    reg.counter("staging.spills").inc()
+    reg.counter("staging.spill_bytes").inc(nbytes)
+
+
+def spill_count() -> int:
+    """Cumulative process-wide spill submissions (journal field source)."""
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    return int(global_registry().counter("staging.spills").value)
+
 # ---------------------------------------------------------------------
 # optional spill/checkpoint compression (round 5)
 #
@@ -317,6 +339,7 @@ class SpillWriter:
             self._fb_q.task_done()
 
     def submit(self, path: str, arr: np.ndarray) -> None:
+        _count_spill(arr.nbytes)
         if self._codec:
             arr = np.frombuffer(
                 compress_array(arr, self._codec, self._level), np.uint8)
@@ -360,6 +383,7 @@ class SpillWriter:
 def write_array(path: str, arr: np.ndarray, use_native: bool = True,
                 codec: str = "", level: int = 1) -> None:
     """Synchronous single-array spill (optionally compressed)."""
+    _count_spill(arr.nbytes)
     if codec:
         arr = np.frombuffer(compress_array(arr, codec, level), np.uint8)
     arr = np.ascontiguousarray(arr)
@@ -421,4 +445,4 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
 
 __all__ = ["HostBufferPool", "HostBuffer", "SpillWriter", "write_array",
            "read_array", "load_native", "compress_array",
-           "decompress_blob"]
+           "decompress_blob", "spill_count"]

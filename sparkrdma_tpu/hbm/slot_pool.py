@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from sparkrdma_tpu.config import ShuffleConf, size_class
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
 
 
 class Slot:
@@ -88,7 +89,8 @@ class SlotPool:
     """Per-process pool of exchange slots, bucketed by power-of-two class."""
 
     def __init__(self, conf: Optional[ShuffleConf] = None,
-                 device: Optional[jax.Device] = None):
+                 device: Optional[jax.Device] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         self.conf = conf or ShuffleConf()
         self.device = device
         self._free: Dict[Tuple[int, int], List[jax.Array]] = defaultdict(list)
@@ -99,6 +101,15 @@ class SlotPool:
         self.misses = 0
         self.preallocated = 0
         self.donated_dropped = 0
+        # occupancy: buffers handed out and not yet returned. The
+        # high-water mark answers "how many slots were live at peak" —
+        # the journal's pool-pressure field.
+        self.outstanding = 0
+        self.outstanding_high_water = 0
+        # null registry keeps the hand-out path branch-free when the
+        # manager runs without metrics
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry(enabled=False)
         for records, count in self.conf.prealloc_classes().items():
             cls = size_class(records)
             for _ in range(count):
@@ -107,6 +118,24 @@ class SlotPool:
                 self.preallocated += 1
 
     # ------------------------------------------------------------------
+    def _track_out(self) -> None:
+        """One buffer handed out: bump occupancy + high-water."""
+        with self._lock:
+            self.outstanding += 1
+            if self.outstanding > self.outstanding_high_water:
+                self.outstanding_high_water = self.outstanding
+            out = self.outstanding
+        self.metrics.gauge("pool.outstanding").set(out)
+
+    def _track_in(self) -> None:
+        """One buffer came back (pooled OR dropped as donated — either
+        way it is no longer outstanding)."""
+        with self._lock:
+            if self.outstanding > 0:
+                self.outstanding -= 1
+            out = self.outstanding
+        self.metrics.gauge("pool.outstanding").set(out)
+
     def _alloc(self, capacity: int, record_words: int) -> jax.Array:
         self.allocations += 1
         arr = jnp.zeros((capacity, record_words), dtype=jnp.uint32)
@@ -142,12 +171,16 @@ class SlotPool:
                 self.donated_dropped += 1
         if arr is None:
             self.misses += 1
+            self.metrics.counter("pool.misses").inc()
             arr = self._alloc(cls, rw)
         else:
             self.hits += 1
+            self.metrics.counter("pool.hits").inc()
+        self._track_out()
         return Slot(arr, cls, rw, self)
 
     def _put(self, slot: Slot) -> None:
+        self._track_in()
         # A slot whose array was donated into a jitted step is dead; returning
         # it would hand a deleted buffer to the next get().
         if slot.array.is_deleted():
@@ -184,6 +217,7 @@ class SlotPool:
         if arr is None:
             self.misses += 1
             self.allocations += 1
+            self.metrics.counter("pool.misses").inc()
             if sharding is not None:
                 arr = jax.jit(
                     lambda: jnp.zeros(shape, dtype),
@@ -194,6 +228,8 @@ class SlotPool:
                     arr = jax.device_put(arr, self.device)
         else:
             self.hits += 1
+            self.metrics.counter("pool.hits").inc()
+        self._track_out()
         return arr
 
     def put_shaped(self, arr: jax.Array, sharding=None) -> None:
@@ -203,6 +239,7 @@ class SlotPool:
         later ``get_shaped`` that donates it into a new program is
         sequenced after those reads by the runtime's dataflow order.
         """
+        self._track_in()
         if arr.is_deleted():
             self.donated_dropped += 1
             return
@@ -226,6 +263,8 @@ class SlotPool:
             "misses": self.misses,
             "preallocated": self.preallocated,
             "donated_dropped": self.donated_dropped,
+            "outstanding": self.outstanding,
+            "outstanding_high_water": self.outstanding_high_water,
         }
 
 

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from sparkrdma_tpu.utils.compat import enable_x64
+
 
 def _sort_rows(records: jax.Array, num_keys: int,
                lead_keys: Tuple[jax.Array, ...] = ()) -> jax.Array:
@@ -141,7 +143,7 @@ def packed_lexsort_cols(
     u32, and the process-wide x64 flag is untouched.
     """
     w, n = cols.shape
-    with jax.enable_x64(True):
+    with enable_x64(True):
         keys = []
         for i in range(0, key_words - 1, 2):
             keys.append(_pack_u64(cols[i], cols[i + 1]))

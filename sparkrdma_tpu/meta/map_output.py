@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.runtime.mesh import ManagerId
 
 
@@ -67,10 +68,13 @@ class MapOutputRegistry:
     kept single-writer-per-shuffle by convention (SURVEY.md §5 race row).
     """
 
-    def __init__(self, manager_ids: Tuple[ManagerId, ...]):
+    def __init__(self, manager_ids: Tuple[ManagerId, ...],
+                 metrics: Optional[MetricsRegistry] = None):
         self._managers = tuple(manager_ids)
         self._shuffles: Dict[int, ShuffleMeta] = {}
         self._lock = threading.Lock()
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry(enabled=False)
 
     # --- membership (hello/announce analogue) -------------------------
     @property
@@ -86,7 +90,10 @@ class MapOutputRegistry:
                     f"shuffle {shuffle_id} already registered")
             meta = ShuffleMeta(shuffle_id, num_parts, partitioner)
             self._shuffles[shuffle_id] = meta
-            return meta
+            live = len(self._shuffles)
+        self.metrics.counter("meta.registrations").inc()
+        self.metrics.gauge("meta.registered_shuffles").set(live)
+        return meta
 
     def publish_map_output(self, shuffle_id: int, counts: np.ndarray) -> None:
         """Record the host copy of the size table after the map stage."""
@@ -94,6 +101,9 @@ class MapOutputRegistry:
             meta = self._shuffles[shuffle_id]
             meta.counts = np.asarray(counts, dtype=np.int64)
             meta.map_published_at = time.monotonic()
+            published = int(meta.counts.sum())
+        self.metrics.counter("meta.map_outputs_published").inc()
+        self.metrics.counter("meta.map_records_published").inc(published)
 
     def get(self, shuffle_id: int) -> ShuffleMeta:
         with self._lock:
@@ -102,6 +112,8 @@ class MapOutputRegistry:
     def unregister(self, shuffle_id: int) -> None:
         with self._lock:
             self._shuffles.pop(shuffle_id, None)
+            live = len(self._shuffles)
+        self.metrics.gauge("meta.registered_shuffles").set(live)
 
     def shuffle_ids(self) -> Tuple[int, ...]:
         with self._lock:

@@ -1,0 +1,31 @@
+"""Unified observability: metrics registry, exchange journal, read stats.
+
+See :mod:`sparkrdma_tpu.obs.metrics` for the registry contract,
+:mod:`sparkrdma_tpu.obs.journal` for the JSON-lines exchange journal, and
+``scripts/shuffle_report.py`` for the offline aggregator.
+"""
+
+from sparkrdma_tpu.obs.journal import (
+    SCHEMA_VERSION,
+    ExchangeJournal,
+    ExchangeSpan,
+    next_span_id,
+    read_journal,
+)
+from sparkrdma_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    global_registry,
+    set_global_registry,
+)
+from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "global_registry", "set_global_registry",
+    "ExchangeJournal", "ExchangeSpan", "read_journal", "next_span_id",
+    "SCHEMA_VERSION",
+    "ExchangeRecord", "ShuffleReadStats",
+]

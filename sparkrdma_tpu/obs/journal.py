@@ -1,0 +1,152 @@
+"""Structured exchange journal — one JSON-lines span per shuffle read.
+
+The reference's observability output is a histogram printed to the
+executor LOG (``RdmaShuffleReaderStats.printRemoteFetchHistogram``) —
+human-greppable, machine-hostile. The journal replaces that with one
+machine-readable record per executed exchange, appended to a configurable
+JSON-lines sink (``ShuffleConf.metrics_sink``), carrying everything needed
+to answer "which exchange round, which peer, which pool is slow" offline:
+
+- identity: monotonically increasing ``span_id`` (also threaded into the
+  ``jax.profiler`` annotation names via
+  :func:`sparkrdma_tpu.utils.profiling.annotate_span`, so XProf trace
+  regions and journal lines correlate by id), ``shuffle_id``, transport;
+- phase wall-clocks: ``plan_s`` / ``exchange_s`` / ``sort_s`` (sort is
+  0.0 when fused into the exchange program — the full-range default);
+- volume: ``rounds``, ``dispatches``, ``records``, ``record_bytes``,
+  ``total_bytes``;
+- skew: ``per_peer_records`` — records contributed by each source device
+  (the ``RdmaShuffleReaderStats`` per-remote-executor table, machine-
+  readable);
+- pressure: slot-pool occupancy high-water, cumulative host-staging
+  spill count, retry count.
+
+Aggregate with ``scripts/shuffle_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import threading
+import time
+from typing import IO, List, Optional, Union
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class ExchangeSpan:
+    """One shuffle read's observables — the journal line, typed.
+
+    The superset of the legacy ``ExchangeRecord``; every field is plain
+    JSON (lists, not ndarrays) so a line round-trips losslessly.
+    """
+
+    span_id: int
+    shuffle_id: int
+    transport: str
+    rounds: int
+    dispatches: int
+    records: int
+    record_bytes: int                      # bytes per record
+    plan_s: float
+    exchange_s: float
+    sort_s: float
+    per_peer_records: List[int]
+    pool_high_water: int = 0
+    spill_count: int = 0
+    retry_count: int = 0
+    ts: float = dataclasses.field(default_factory=time.time)
+    schema: int = SCHEMA_VERSION
+
+    @property
+    def total_bytes(self) -> int:
+        return self.records * self.record_bytes
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["total_bytes"] = self.total_bytes
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExchangeSpan":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+_span_id_lock = threading.Lock()
+_span_id_next = 0
+
+
+def next_span_id() -> int:
+    """Process-wide monotone span id (shared across managers, so trace
+    annotations never collide even with several managers alive)."""
+    global _span_id_next
+    with _span_id_lock:
+        _span_id_next += 1
+        return _span_id_next
+
+
+class ExchangeJournal:
+    """Append-only JSON-lines sink for :class:`ExchangeSpan` records.
+
+    ``sink`` may be a filesystem path (opened lazily, append mode — the
+    file is only created once a span is actually emitted, so a disabled
+    or idle journal leaves no artifact), a file-like object (tests,
+    in-memory capture), or None/"" (disabled: :meth:`emit` is a no-op
+    and no I/O ever happens).
+    """
+
+    def __init__(self, sink: Union[str, IO[str], None] = None):
+        self._path: Optional[str] = None
+        self._fh: Optional[IO[str]] = None
+        self._own_fh = False
+        self._lock = threading.Lock()
+        self.emitted = 0
+        if sink is None or sink == "":
+            pass
+        elif isinstance(sink, str):
+            self._path = sink
+        elif isinstance(sink, io.IOBase) or hasattr(sink, "write"):
+            self._fh = sink
+        else:
+            raise TypeError(f"unsupported journal sink {sink!r}")
+
+    @property
+    def enabled(self) -> bool:
+        return self._path is not None or self._fh is not None
+
+    def emit(self, span: ExchangeSpan) -> None:
+        if not self.enabled:
+            return
+        line = json.dumps(span.to_dict(), separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self._path, "a", encoding="utf-8")
+                self._own_fh = True
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self.emitted += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and self._own_fh:
+                self._fh.close()
+                self._fh = None
+
+
+def read_journal(path: str) -> List[ExchangeSpan]:
+    """Parse a journal file back into spans (blank lines skipped)."""
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                spans.append(ExchangeSpan.from_dict(json.loads(line)))
+    return spans
+
+
+__all__ = ["ExchangeSpan", "ExchangeJournal", "read_journal",
+           "next_span_id", "SCHEMA_VERSION"]

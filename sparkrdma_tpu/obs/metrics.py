@@ -1,0 +1,294 @@
+"""Process-wide metrics registry — counters, gauges, bounded histograms.
+
+The reference's only metrics surface is ``RdmaShuffleReaderStats`` (a
+per-remote-executor fetch histogram dumped to the executor log behind
+``spark.shuffle.rdma.collectShuffleReadStats``) plus whatever Spark's own
+``ShuffleReadMetrics`` counts. This module is the unified replacement: one
+:class:`MetricsRegistry` per process that every subsystem (exchange
+transports, slot pool, host staging, map-output registry, SPI layer) feeds,
+queryable as a flat snapshot and serializable into the exchange journal
+(:mod:`sparkrdma_tpu.obs.journal`).
+
+Design constraints, in order:
+
+1. **Near-zero overhead and allocation-free when disabled.** A disabled
+   registry hands out shared singleton null instruments whose methods are
+   constant no-ops; ``registry.counter(name)`` on the disabled path does a
+   single attribute load + return — no dict insertion, no object creation.
+   Hot paths may therefore keep unconditional ``metrics.counter(...)``
+   calls without a guard.
+2. **Thread-safe.** Instrument creation is locked; increments use a lock
+   per instrument only where torn updates could corrupt state (histogram
+   buckets); plain counter/gauge updates ride the GIL like the reference's
+   LongAdder-lite counters.
+3. **Bounded memory.** Histograms are fixed-bucket (no per-sample
+   storage); the registry refuses nothing but also never grows per-event.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Monotonic counter (``LongAdder`` analogue)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def inc(self, n: Number = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value with a high-water mark.
+
+    ``set`` tracks the current value; ``high_water`` remembers the max
+    ever set — the slot-pool occupancy question ("how many buffers were
+    live at peak") is a high-water read, not a current read.
+    """
+
+    __slots__ = ("name", "_value", "_high")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._high = 0
+
+    def set(self, v: Number) -> None:
+        self._value = v
+        if v > self._high:
+            self._high = v
+
+    def add(self, delta: Number) -> None:
+        self.set(self._value + delta)
+
+    def update_max(self, v: Number) -> None:
+        """Raise the high-water mark without touching the current value."""
+        if v > self._high:
+            self._high = v
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+    @property
+    def high_water(self) -> Number:
+        return self._high
+
+
+class Histogram:
+    """Fixed-boundary bucketed histogram (bounded memory per instrument).
+
+    ``bounds`` are the inclusive upper edges of each bucket; one overflow
+    bucket catches everything above the last edge. Tracks count / sum /
+    min / max alongside, so mean and range survive the bucketing.
+    """
+
+    __slots__ = ("name", "bounds", "_buckets", "_count", "_sum",
+                 "_min", "_max", "_lock")
+
+    DEFAULT_BOUNDS: Tuple[float, ...] = (
+        1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+    def __init__(self, name: str,
+                 bounds: Optional[Sequence[Number]] = None):
+        self.name = name
+        b = tuple(bounds) if bounds is not None else self.DEFAULT_BOUNDS
+        if not b or list(b) != sorted(b):
+            raise ValueError(f"histogram bounds must be ascending, got {b}")
+        self.bounds = b
+        self._buckets = [0] * (len(b) + 1)   # +1 overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: Number) -> None:
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._buckets[i] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "bounds": list(self.bounds),
+                "buckets": list(self._buckets),
+            }
+
+
+class _NullCounter(Counter):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__("<disabled>")
+
+    def inc(self, n: Number = 1) -> None:
+        pass
+
+
+class _NullGauge(Gauge):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__("<disabled>")
+
+    def set(self, v: Number) -> None:
+        pass
+
+    def add(self, delta: Number) -> None:
+        pass
+
+    def update_max(self, v: Number) -> None:
+        pass
+
+
+class _NullHistogram(Histogram):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__("<disabled>", bounds=(0,))
+
+    def observe(self, v: Number) -> None:
+        pass
+
+
+# shared singletons: the disabled path allocates nothing per call
+_NULL_COUNTER = _NullCounter()
+_NULL_GAUGE = _NullGauge()
+_NULL_HISTOGRAM = _NullHistogram()
+
+
+class MetricsRegistry:
+    """Named instrument registry; the process-wide metrics root.
+
+    One registry per :class:`~sparkrdma_tpu.api.shuffle_manager
+    .ShuffleManager` (constructed from its conf), or the module-level
+    :func:`global_registry` for components with no manager in reach
+    (host staging's spill counters). Disabled registries hand out null
+    instruments — see the module docstring's overhead contract.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return _NULL_COUNTER
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return _NULL_GAUGE
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str,
+                  bounds: Optional[Sequence[Number]] = None) -> Histogram:
+        if not self.enabled:
+            return _NULL_HISTOGRAM
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name,
+                                                Histogram(name, bounds))
+        return h
+
+    def snapshot(self) -> Dict[str, object]:
+        """Flat JSON-ready dict of every instrument's current state."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._histograms.values())
+        out: Dict[str, object] = {}
+        for c in counters:
+            out[c.name] = c.value
+        for g in gauges:
+            out[g.name] = g.value
+            out[g.name + ".high_water"] = g.high_water
+        for h in hists:
+            out[h.name] = h.snapshot()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_global_lock = threading.Lock()
+_global: Optional[MetricsRegistry] = None
+
+
+def global_registry() -> MetricsRegistry:
+    """The process-wide default registry (always enabled).
+
+    Components that outlive or predate any ShuffleManager (host staging
+    spill counters, module-level pools) record here; managers fold the
+    relevant globals into their spans at emit time.
+    """
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = MetricsRegistry(enabled=True)
+    return _global
+
+
+def set_global_registry(reg: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-wide registry (tests); returns the previous one."""
+    global _global
+    with _global_lock:
+        prev = _global if _global is not None else MetricsRegistry()
+        _global = reg
+    return prev
+
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "global_registry", "set_global_registry"]

@@ -1,10 +1,72 @@
 """Version-compat shims shared across the package."""
 
+import inspect
+
 import jax
 
 try:  # jax >= 0.7 promotes shard_map to the top level
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
 
-__all__ = ["shard_map"]
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    """``shard_map`` accepting either replication-check spelling.
+
+    jax renamed ``check_rep`` to ``check_vma`` (~0.6). Callers here use
+    the new name; on older jax the kwarg is translated (same meaning:
+    let the partitioner verify claimed output replication) so one
+    codebase runs on both sides of the rename.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        vma = kwargs.pop("check_vma")
+        if "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = vma
+    return _shard_map(*args, **kwargs)
+
+
+try:  # jax >= 0.5 exposes the x64 trace context at the top level
+    enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover
+    from jax.experimental import enable_x64  # type: ignore
+
+
+_SDS_HAS_VMA = "vma" in inspect.signature(
+    jax.ShapeDtypeStruct.__init__).parameters
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` tolerating the ``vma`` kwarg.
+
+    Newer jax lets out-shapes declare their varying-manual-axes set; on
+    older jax the kwarg doesn't exist and the partitioner infers the
+    same thing, so it is simply dropped.
+    """
+    if vma is not None and _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params under either class name / field set.
+
+    ``pltpu.TPUCompilerParams`` lost its prefix (became ``CompilerParams``)
+    when pallas stabilized, and grew fields (``has_side_effects``) along
+    the way. Construct whichever class this jax ships, dropping fields it
+    does not know — the dropped ones are hints (DCE protection for a
+    kernel whose output is consumed anyway), not semantics.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover - depends on installed jax
+        cls = pltpu.TPUCompilerParams
+    accepted = frozenset(inspect.signature(cls).parameters)
+    return cls(**{k: v for k, v in kwargs.items() if k in accepted})
+
+
+__all__ = ["shard_map", "enable_x64", "shape_dtype_struct",
+           "tpu_compiler_params"]
+

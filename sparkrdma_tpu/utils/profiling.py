@@ -49,6 +49,17 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def annotate_span(phase: str, span_id: int = 0):
+    """Phase annotation carrying the exchange-journal span id.
+
+    Emits ``plan#s42`` instead of ``plan`` so a region in the XProf
+    timeline and a line in the JSON-lines journal (which records the
+    same ``span_id``) identify the same exchange. Falls back to the
+    plain phase name when no span id is in flight (journal disabled).
+    """
+    return annotate(f"{phase}#s{span_id}" if span_id else phase)
+
+
 @contextlib.contextmanager
 def maybe_trace(log_dir: Optional[str]) -> Iterator[None]:
     """``trace`` when a directory is configured, no-op otherwise."""

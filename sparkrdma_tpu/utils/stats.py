@@ -1,87 +1,19 @@
-"""Shuffle read statistics — the ``RdmaShuffleReaderStats`` analogue.
+"""Shuffle read statistics — compatibility shim over :mod:`sparkrdma_tpu.obs`.
 
-The reference optionally histograms fetch latency per remote executor
-(behind ``spark.shuffle.rdma.collectShuffleReadStats``) and dumps the
-histogram to the executor log; Spark's own ShuffleReadMetrics counts bytes
-and records. One compiled exchange gives different observables: per-source
-record counts (from the size exchange — the incoming metadata table),
-wall-clock per phase (plan/execute), and derived per-chip throughput. We
-keep the per-peer histogram idea with bytes in place of latency.
+``ExchangeRecord`` / ``ShuffleReadStats`` (the ``RdmaShuffleReaderStats``
+analogue) moved to :mod:`sparkrdma_tpu.obs.stats` where they feed the
+unified metrics registry; this module re-exports them so every existing
+import path keeps working. ``Timer`` and ``barrier`` (timing utilities,
+not stats) live here.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import logging
 import time
-from typing import Dict, List, Optional
 
 import numpy as np
 
-log = logging.getLogger("sparkrdma_tpu.stats")
-
-
-@dataclasses.dataclass
-class ExchangeRecord:
-    """One exchange's observables."""
-
-    shuffle_id: int
-    plan_s: float
-    exec_s: float
-    total_records: int
-    record_bytes: int
-    num_rounds: int
-    per_source_records: np.ndarray   # [mesh] records received per source
-
-    @property
-    def total_bytes(self) -> int:
-        return self.total_records * self.record_bytes
-
-    @property
-    def gbps(self) -> float:
-        return self.total_bytes / max(self.exec_s, 1e-9) / 1e9
-
-
-class ShuffleReadStats:
-    """Accumulates exchange records; prints histograms like the reference."""
-
-    def __init__(self, enabled: bool = True):
-        self.enabled = enabled
-        self.records: List[ExchangeRecord] = []
-
-    def add(self, rec: ExchangeRecord) -> None:
-        if self.enabled:
-            self.records.append(rec)
-
-    def per_source_histogram(self) -> Dict[int, int]:
-        """Total records fetched per source device across all exchanges."""
-        out: Dict[int, int] = {}
-        for r in self.records:
-            for s, c in enumerate(r.per_source_records):
-                out[s] = out.get(s, 0) + int(c)
-        return out
-
-    def summary(self) -> Dict[str, float]:
-        if not self.records:
-            return {}
-        return {
-            "exchanges": len(self.records),
-            "total_records": sum(r.total_records for r in self.records),
-            "total_bytes": sum(r.total_bytes for r in self.records),
-            "mean_exec_s": float(np.mean([r.exec_s for r in self.records])),
-            "mean_gbps": float(np.mean([r.gbps for r in self.records])),
-        }
-
-    def print_histogram(self) -> str:
-        """Log + return the per-source fetch table (reference: dumped to
-        executor log by printRemoteFetchHistogram)."""
-        hist = self.per_source_histogram()
-        lines = ["shuffle fetch per-source records:"]
-        for s in sorted(hist):
-            lines.append(f"  source {s}: {hist[s]}")
-        text = "\n".join(lines)
-        log.info("%s", text)
-        return text
+from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
 
 
 class Timer:
@@ -101,14 +33,23 @@ def barrier(*arrays) -> None:
     finishes); transferring a single element of each array forces the
     producing executable to complete on any backend, at the cost of a
     few bytes of D2H. Use at the edges of timed regions.
+
+    Accepts anything ``block_until_ready`` does: arrays of any rank
+    including 0-d (indexed with the empty tuple), zero-size arrays
+    (nothing to materialize — the block is the whole barrier), and
+    non-array leaves (skipped).
     """
     import jax
 
     for a in arrays:
         jax.block_until_ready(a)
+        ndim = getattr(a, "ndim", None)
+        size = getattr(a, "size", None)
+        if ndim is None or size is None or size == 0:
+            continue  # non-array or empty: block_until_ready must do
         try:
-            np.asarray(a[(0,) * a.ndim])
-        except Exception:  # non-indexable / non-addressable: block must do
+            np.asarray(a[(0,) * ndim])
+        except (IndexError, TypeError):  # non-indexable sharded layout
             pass
 
 

@@ -1,0 +1,380 @@
+"""Observability stack: metrics registry, exchange journal, report CLI.
+
+Covers the contracts the obs package promises:
+
+- registry semantics (counters / gauges / bounded histograms) and the
+  allocation-free disabled path (shared null singletons);
+- journal schema round-trip (ExchangeSpan <-> dict <-> JSON line) and
+  the lazy-sink rule (no file until a span is actually emitted);
+- scripts/shuffle_report.py aggregation on a fixture journal (imported
+  in-process — the CLI is stdlib-only by design);
+- the E2E acceptance path: a real ShuffleManager write->read with
+  ``metrics_sink`` set emits one span whose per-peer table, phase
+  timings, and round count match the plan; the same run with the sink
+  disabled emits nothing;
+- ``utils.stats.barrier`` edge cases (0-d, empty, non-array leaves).
+"""
+
+import importlib.util
+import io
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+from sparkrdma_tpu.obs import (ExchangeJournal, ExchangeSpan, Histogram,
+                               MetricsRegistry, ShuffleReadStats,
+                               global_registry, next_span_id, read_journal,
+                               set_global_registry)
+from sparkrdma_tpu.utils.stats import barrier
+
+REPO = Path(__file__).resolve().parent.parent
+
+# the report CLI is stdlib-only, so importing it in-process keeps these
+# tests in the fast tier (no worker processes involved)
+_spec = importlib.util.spec_from_file_location(
+    "shuffle_report", REPO / "scripts" / "shuffle_report.py")
+shuffle_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(shuffle_report)
+
+
+def make_span(span_id=1, shuffle_id=0, peers=(10, 10, 10, 10), **kw):
+    base = dict(span_id=span_id, shuffle_id=shuffle_id, transport="fused",
+                rounds=1, dispatches=1, records=sum(peers), record_bytes=16,
+                plan_s=0.01, exchange_s=0.05, sort_s=0.0,
+                per_peer_records=list(peers))
+    base.update(kw)
+    return ExchangeSpan(**base)
+
+
+class TestMetricsRegistry:
+    def test_counter_semantics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x")
+        c.inc()
+        c.inc(41)
+        assert c.value == 42
+        assert reg.counter("x") is c, "same name must be same instrument"
+        assert reg.snapshot()["x"] == 42
+
+    def test_gauge_high_water(self):
+        g = MetricsRegistry().gauge("pool")
+        g.set(3)
+        g.set(7)
+        g.set(2)
+        assert g.value == 2 and g.high_water == 7
+        g.add(4)
+        assert g.value == 6
+        g.update_max(100)
+        assert g.value == 6 and g.high_water == 100
+
+    def test_histogram_buckets_and_stats(self):
+        h = MetricsRegistry().histogram("lat", bounds=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == [1, 1, 1]    # <=1, <=10, overflow
+        assert h.count == 3
+        assert h.mean == pytest.approx(55.5 / 3)
+        assert snap["min"] == 0.5 and snap["max"] == 50.0
+
+    def test_histogram_rejects_unsorted_bounds(self):
+        with pytest.raises(ValueError):
+            Histogram("bad", bounds=(5.0, 1.0))
+
+    def test_disabled_registry_shares_null_singletons(self):
+        r1 = MetricsRegistry(enabled=False)
+        r2 = MetricsRegistry(enabled=False)
+        # allocation-free contract: every name, every registry -> the
+        # same shared no-op instrument, and nothing accumulates
+        assert r1.counter("a") is r2.counter("b")
+        assert r1.gauge("a") is r2.gauge("b")
+        assert r1.histogram("a") is r2.histogram("b")
+        r1.counter("a").inc(10)
+        r1.gauge("a").set(10)
+        r1.histogram("a").observe(10)
+        assert r1.counter("a").value == 0
+        assert r1.gauge("a").value == 0 and r1.gauge("a").high_water == 0
+        assert r1.histogram("a").count == 0
+        assert r1.snapshot() == {}
+
+    def test_global_registry_swap(self):
+        fresh = MetricsRegistry()
+        prev = set_global_registry(fresh)
+        try:
+            assert global_registry() is fresh
+            global_registry().counter("g").inc()
+            assert fresh.counter("g").value == 1
+        finally:
+            set_global_registry(prev)
+        assert global_registry() is prev
+
+    def test_stats_feed_registry(self):
+        from sparkrdma_tpu.obs.stats import ExchangeRecord
+
+        reg = MetricsRegistry()
+        stats = ShuffleReadStats(enabled=True, registry=reg)
+        stats.add(ExchangeRecord(
+            shuffle_id=0, plan_s=0.1, exec_s=0.2, total_records=100,
+            record_bytes=16, num_rounds=2,
+            per_source_records=np.array([25, 25, 25, 25])))
+        assert reg.counter("shuffle.exchanges").value == 1
+        assert reg.counter("shuffle.records").value == 100
+        assert reg.counter("shuffle.bytes").value == 1600
+        assert reg.counter("shuffle.rounds").value == 2
+        assert reg.histogram("shuffle.exec_s").count == 1
+
+
+class TestSpillCounter:
+    def test_count_spill_feeds_global_registry(self):
+        from sparkrdma_tpu.hbm.host_staging import _count_spill, spill_count
+
+        prev = set_global_registry(MetricsRegistry())
+        try:
+            assert spill_count() == 0
+            _count_spill(1024)
+            _count_spill(2048)
+            assert spill_count() == 2
+            g = global_registry()
+            assert g.counter("staging.spill_bytes").value == 3072
+        finally:
+            set_global_registry(prev)
+
+
+class TestJournal:
+    def test_span_round_trip(self, tmp_path):
+        span = make_span(span_id=7, shuffle_id=3, peers=(5, 0, 15, 20),
+                         retry_count=1, pool_high_water=4, spill_count=2)
+        d = span.to_dict()
+        assert d["total_bytes"] == span.records * span.record_bytes
+        assert d["schema"] == 1
+        back = ExchangeSpan.from_dict(d)
+        assert back == span
+
+        path = tmp_path / "j.jsonl"
+        j = ExchangeJournal(str(path))
+        j.emit(span)
+        j.close()
+        (got,) = read_journal(str(path))
+        assert got == span
+        # the line itself is one JSON object per line
+        lines = path.read_text().splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["span_id"] == 7
+
+    def test_from_dict_ignores_unknown_fields(self):
+        span = make_span()
+        d = span.to_dict()
+        d["future_field"] = "whatever"
+        assert ExchangeSpan.from_dict(d) == span
+
+    def test_lazy_sink_creates_no_file_until_emit(self, tmp_path):
+        path = tmp_path / "idle.jsonl"
+        j = ExchangeJournal(str(path))
+        assert j.enabled
+        j.close()
+        assert not path.exists(), "idle journal must leave no artifact"
+        j.emit(make_span())
+        assert path.exists() and j.emitted == 1
+        j.close()
+
+    def test_disabled_sink_is_a_noop(self):
+        for sink in (None, ""):
+            j = ExchangeJournal(sink)
+            assert not j.enabled
+            j.emit(make_span())
+            assert j.emitted == 0
+            j.close()
+
+    def test_file_like_sink(self):
+        buf = io.StringIO()
+        j = ExchangeJournal(buf)
+        j.emit(make_span(span_id=1))
+        j.emit(make_span(span_id=2))
+        j.close()   # must NOT close a sink it doesn't own
+        assert not buf.closed
+        ids = [json.loads(ln)["span_id"]
+               for ln in buf.getvalue().splitlines()]
+        assert ids == [1, 2]
+
+    def test_bad_sink_rejected(self):
+        with pytest.raises(TypeError):
+            ExchangeJournal(42)
+
+    def test_span_ids_monotone(self):
+        a, b, c = next_span_id(), next_span_id(), next_span_id()
+        assert a < b < c
+
+
+class TestShuffleReport:
+    def _fixture_journal(self, tmp_path):
+        path = tmp_path / "fix.jsonl"
+        j = ExchangeJournal(str(path))
+        j.emit(make_span(span_id=1, shuffle_id=0, peers=(10, 10, 10, 10),
+                         rounds=2, plan_s=0.1, exchange_s=0.3, sort_s=0.1))
+        j.emit(make_span(span_id=2, shuffle_id=1, peers=(90, 10, 0, 0),
+                         rounds=3, retry_count=1, pool_high_water=5))
+        j.close()
+        return path
+
+    def test_aggregate(self, tmp_path):
+        path = self._fixture_journal(tmp_path)
+        rep = shuffle_report.aggregate(shuffle_report.load_spans(str(path)))
+        assert rep["spans"] == 2 and rep["shuffles"] == 2
+        assert rep["total_records"] == 140
+        assert rep["total_bytes"] == 140 * 16
+        assert rep["rounds"] == 5
+        assert rep["retries"] == 1
+        assert rep["pool_high_water"] == 5
+        # per-peer table sums across spans
+        assert rep["per_peer_records"] == {
+            "0": 100, "1": 20, "2": 10, "3": 10}
+        assert rep["phases"]["plan_s"] == pytest.approx(0.11)
+        assert sum(rep["phase_share"].values()) == pytest.approx(1.0)
+        # skew: span 2 is 90/25 = 3.6x and must rank first
+        assert rep["skew"][0]["span_id"] == 2
+        assert rep["skew"][0]["skew"] == pytest.approx(3.6)
+        assert rep["per_shuffle"]["1"]["max_skew"] == pytest.approx(3.6)
+
+    def test_cli_json_and_text(self, tmp_path, capsys):
+        path = self._fixture_journal(tmp_path)
+        assert shuffle_report.main([str(path), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["spans"] == 2
+        assert shuffle_report.main([str(path), "--top", "1"]) == 0
+        text = capsys.readouterr().out
+        assert "2 spans across 2 shuffles" in text
+        assert "peer   0" in text
+        assert "3.60x" in text
+
+    def test_empty_and_malformed_lines(self, tmp_path, capsys):
+        path = tmp_path / "bad.jsonl"
+        path.write_text("\n{not json}\n" + json.dumps(make_span().to_dict())
+                        + "\n")
+        spans = shuffle_report.load_spans(str(path))
+        assert len(spans) == 1, "bad lines skipped, good ones kept"
+        assert "bad JSON line skipped" in capsys.readouterr().err
+        assert shuffle_report.aggregate([]) == {"spans": 0}
+
+
+class TestManagerJournalE2E:
+    """The acceptance path: real write->read emits a faithful span."""
+
+    def _run_shuffle(self, conf, rng, shuffle_id=90):
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = manager.runtime.num_partitions
+            handle = manager.register_shuffle(
+                shuffle_id, mesh, modulo_partitioner(mesh))
+            x = rng.integers(1, 2**32, size=(mesh * 16, 4), dtype=np.uint32)
+            writer = manager.get_writer(handle)
+            plan = writer.write(manager.runtime.shard_records(x)).stop(True)
+            out, totals = manager.get_reader(handle).read()
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+            return manager, plan
+        finally:
+            manager.stop()
+
+    def test_journal_span_matches_plan(self, tmp_path, rng):
+        sink = tmp_path / "journal.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                           collect_shuffle_read_stats=True)
+        manager, plan = self._run_shuffle(conf, rng)
+        (span,) = read_journal(str(sink))
+        assert span.shuffle_id == 90
+        assert span.schema == 1
+        assert span.transport == conf.transport
+        assert span.rounds == plan.num_rounds
+        assert span.records == plan.total_records
+        assert span.record_bytes == 4 * 4          # W=4 uint32 words
+        # per-peer receive table == the plan's per-source row sums
+        assert span.per_peer_records == \
+            [int(c) for c in plan.counts.sum(axis=1)]
+        assert span.plan_s > 0 and span.exchange_s > 0
+        assert span.sort_s == 0.0                  # full-range read: fused
+        assert span.retry_count == 0
+        assert span.dispatches >= 1
+        assert span.pool_high_water >= 0 and span.spill_count >= 0
+        # the registry saw the same exchange
+        snap = manager.metrics.snapshot()
+        assert snap["shuffle.exchanges"] == 1
+        assert snap["exchange.plans"] >= 1
+
+    def test_journal_even_without_read_stats(self, tmp_path, rng):
+        """metrics_sink alone turns the journal on — the two knobs are
+        independent (stats in memory vs spans on disk)."""
+        sink = tmp_path / "only_sink.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                           collect_shuffle_read_stats=False)
+        manager, _ = self._run_shuffle(conf, rng, shuffle_id=91)
+        (span,) = read_journal(str(sink))
+        assert span.shuffle_id == 91
+        assert not manager.stats.records, "in-memory stats stay off"
+
+    def test_disabled_sink_emits_nothing(self, tmp_path, rng):
+        """Same run, sink disabled: zero lines, zero files."""
+        before = set(tmp_path.iterdir())
+        conf = ShuffleConf(slot_records=64, metrics_sink="",
+                           collect_shuffle_read_stats=True)
+        manager, _ = self._run_shuffle(conf, rng, shuffle_id=92)
+        assert manager.journal.emitted == 0
+        assert not manager.journal.enabled
+        assert set(tmp_path.iterdir()) == before
+        # stats still work without the journal
+        assert len(manager.stats.records) == 1
+
+    def test_fully_disabled_manager_uses_null_instruments(self):
+        conf = ShuffleConf(slot_records=64, metrics_sink="",
+                           collect_shuffle_read_stats=False)
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            assert not manager.metrics.enabled
+            disabled = MetricsRegistry(enabled=False)
+            assert manager.metrics.counter("x") is disabled.counter("y")
+            assert manager.metrics.snapshot() == {}
+        finally:
+            manager.stop()
+
+    def test_retry_count_lands_in_span(self, tmp_path, rng):
+        """An injected fault consumed by the retry loop shows up as
+        retry_count in the span, not as a separate span."""
+        sink = tmp_path / "retry.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                           collect_shuffle_read_stats=True,
+                           max_retry_attempts=3)
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = manager.runtime.num_partitions
+            handle = manager.register_shuffle(93, mesh,
+                                              modulo_partitioner(mesh))
+            x = rng.integers(1, 2**32, size=(mesh * 16, 4), dtype=np.uint32)
+            manager.get_writer(handle).write(
+                manager.runtime.shard_records(x)).stop(True)
+            fails = [True]   # first attempt faults, the retry succeeds
+            manager._exchange.fault_hook = \
+                lambda: fails.pop() if fails else False
+            manager.get_reader(handle).read()
+        finally:
+            manager.stop()
+        (span,) = read_journal(str(sink))
+        assert span.retry_count == 1
+        assert manager.metrics.counter("exchange.faults").value == 1
+
+
+class TestBarrierEdgeCases:
+    def test_zero_dim_array(self):
+        barrier(jnp.asarray(3.5))              # 0-d: indexed with ()
+
+    def test_empty_array(self):
+        barrier(jnp.zeros((0,), jnp.uint32))   # nothing to materialize
+        barrier(jnp.zeros((4, 0), jnp.uint32))
+
+    def test_non_array_leaves(self):
+        barrier(3, None, "str", [1, 2])        # skipped, not an error
+
+    def test_regular_arrays(self):
+        barrier(jnp.arange(8), np.arange(3).reshape(1, 3))
