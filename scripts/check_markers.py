@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Test-hygiene lint, run at the top of the tier-1 command (ROADMAP.md).
 
-Two invariants keep the CPU tier-1 suite honest:
+Three invariants keep the CPU tier-1 suite honest:
 
 1. **Importability** — every ``tests/test_*.py`` must import cleanly
    under ``JAX_PLATFORMS=cpu``. A module that dies at import time makes
@@ -13,6 +13,12 @@ Two invariants keep the CPU tier-1 suite honest:
    (``tests/mp_worker.py`` or the ``subprocess`` module) must carry at
    least one ``pytest.mark.slow``, so ``-m 'not slow'`` actually excludes
    the multi-process tests it promises to exclude.
+3. **Journal schema sync** — every span field the offline CLIs
+   (``scripts/shuffle_report.py``, ``scripts/shuffle_trace.py``) read
+   via ``s.get("...")`` / ``span.get("...")`` must exist on
+   ``ExchangeSpan``. The CLIs are stdlib-only and never import the
+   dataclass, so a schema rename would otherwise silently turn their
+   reads into defaults instead of failing.
 
 Static checks only read source; the import check executes module tops,
 which for this suite is cheap (heavy work lives inside test bodies).
@@ -22,6 +28,7 @@ from __future__ import annotations
 
 import importlib.util
 import os
+import re
 import sys
 import traceback
 from pathlib import Path
@@ -59,6 +66,39 @@ def check_slow_marked(path: Path) -> str:
     return ""
 
 
+#: CLI scripts whose span-field reads must match the dataclass
+SPAN_READERS = ("shuffle_report.py", "shuffle_trace.py")
+
+#: span-field access pattern the lint recognizes; by convention the CLIs
+#: bind a span dict to ``s`` or ``span`` before reading fields from it
+SPAN_GET = re.compile(r'\b(?:s|span)\.get\(\s*"([A-Za-z0-9_]+)"')
+
+
+def check_span_schema_sync() -> str:
+    """Span fields read by the CLIs must exist on ExchangeSpan; '' if so.
+
+    ``total_bytes`` (a derived property serialized by ``to_dict``) and
+    ``kind`` (the auxiliary-line tag, absent on spans by design) are
+    allowed on top of the dataclass fields.
+    """
+    import dataclasses
+
+    from sparkrdma_tpu.obs.journal import ExchangeSpan
+
+    allowed = ({f.name for f in dataclasses.fields(ExchangeSpan)}
+               | {"total_bytes", "kind"})
+    bad = []
+    for script in SPAN_READERS:
+        src = (REPO / "scripts" / script).read_text(encoding="utf-8")
+        for m in SPAN_GET.finditer(src):
+            if m.group(1) not in allowed:
+                bad.append(f"scripts/{script} reads span field "
+                           f"{m.group(1)!r} which does not exist on "
+                           "ExchangeSpan — rename the field or fix the "
+                           "script")
+    return "\n".join(bad)
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, str(REPO))
@@ -74,13 +114,16 @@ def main() -> int:
         err = check_importable(path)
         if err:
             failures.append(("import", path.name, err))
+    err = check_span_schema_sync()
+    if err:
+        failures.append(("schema-sync", "scripts", err))
     if failures:
         print(f"check_markers: {len(failures)} failure(s)", file=sys.stderr)
         for kind, name, err in failures:
             print(f"--- [{kind}] {name}\n{err}", file=sys.stderr)
         return 1
     print(f"check_markers: {len(modules)} test modules importable, "
-          "slow markers consistent")
+          "slow markers consistent, CLI span reads schema-synced")
     return 0
 
 

@@ -11,7 +11,12 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
 - per-peer receive table: records contributed by each source device,
   summed across spans — the ``printRemoteFetchHistogram`` table;
 - skew report: max/mean per-peer ratio per span, worst offenders first;
-- pressure: slot-pool occupancy high-water, spill count, retries.
+- pressure: slot-pool occupancy high-water, spill count, retries;
+- cross-host stragglers: with several journals (one per host via the
+  ``{process}`` sink placeholder), the slowest host per shuffle and the
+  per-host exchange-time spread;
+- ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
+  stalls, retries) to the ShuffleConf knob that addresses them.
 
 Stdlib only (no jax / numpy): runs anywhere the journal file lands,
 including hosts with no accelerator stack installed.
@@ -19,8 +24,10 @@ including hosts with no accelerator stack installed.
 Usage::
 
     python scripts/shuffle_report.py /path/to/journal.jsonl
-    python scripts/shuffle_report.py journal.jsonl --json   # machine form
-    python scripts/shuffle_report.py journal.jsonl --top 5  # worst skew
+    python scripts/shuffle_report.py j_0.jsonl j_1.jsonl  # multi-host
+    python scripts/shuffle_report.py journal.jsonl --json # machine form
+    python scripts/shuffle_report.py journal.jsonl --top 5 # worst skew
+    python scripts/shuffle_report.py journal.jsonl --doctor
 """
 
 from __future__ import annotations
@@ -28,22 +35,38 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 
-def load_spans(path: str) -> List[dict]:
-    spans = []
+def load_entries(path: str) -> List[dict]:
+    """All JSON-object lines: spans AND auxiliary (``kind``) lines."""
+    entries = []
     with open(path, encoding="utf-8") as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
-                spans.append(json.loads(line))
+                obj = json.loads(line)
             except json.JSONDecodeError as e:
                 print(f"warning: {path}:{ln}: bad JSON line skipped ({e})",
                       file=sys.stderr)
-    return spans
+                continue
+            if isinstance(obj, dict):
+                entries.append(obj)
+    return entries
+
+
+def split_entries(entries: List[dict]) -> Tuple[List[dict], List[dict]]:
+    """Partition journal lines into (spans, stalls); drop unknown kinds."""
+    spans = [e for e in entries if e.get("kind") in (None, "span")]
+    stalls = [e for e in entries if e.get("kind") == "stall"]
+    return spans, stalls
+
+
+def load_spans(path: str) -> List[dict]:
+    """Exchange spans of one journal (auxiliary lines skipped)."""
+    return split_entries(load_entries(path))[0]
 
 
 def span_skew(span: dict) -> float:
@@ -128,6 +151,82 @@ def aggregate(spans: List[dict]) -> dict:
     }
 
 
+def host_breakdown(spans: List[dict]) -> dict:
+    """Cross-host straggler view: per-host exchange time per shuffle.
+
+    Hosts come from each span's ``process_index`` (schema v2; v1 spans
+    default to host 0), so it works on one shared journal or several
+    per-host files. ``spread`` is max/min of per-host exchange seconds —
+    1.0 means perfectly balanced hosts, large values mean the slowest
+    host is dragging the collective (every host waits in ICI barriers).
+    """
+    hosts = sorted({int(s.get("process_index", 0) or 0) for s in spans})
+    per_shuffle: Dict[int, Dict[int, float]] = {}
+    for s in spans:
+        sid = int(s.get("shuffle_id", -1))
+        host = int(s.get("process_index", 0) or 0)
+        per_shuffle.setdefault(sid, {})
+        per_shuffle[sid][host] = (per_shuffle[sid].get(host, 0.0)
+                                  + float(s.get("exchange_s", 0.0)))
+    shuffles = {}
+    for sid, by_host in sorted(per_shuffle.items()):
+        times = [by_host.get(h, 0.0) for h in hosts]
+        slowest = max(by_host, key=by_host.get)
+        nonzero = [t for t in times if t > 0]
+        spread = (max(nonzero) / min(nonzero)) if len(nonzero) > 1 else 1.0
+        shuffles[str(sid)] = {
+            "per_host_exchange_s": {str(h): round(by_host.get(h, 0.0), 6)
+                                    for h in hosts},
+            "slowest_host": slowest,
+            "spread": round(spread, 3),
+        }
+    return {"hosts": hosts, "per_shuffle": shuffles}
+
+
+#: skew past this ratio is a geometry problem, not noise — matches the
+#: skew-split planner's own intervention threshold territory
+DOCTOR_SKEW_THRESHOLD = 4.0
+
+
+def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
+    """Rule-based symptom -> knob mapping (the --doctor section)."""
+    findings: List[str] = []
+    skewed = sorted({int(s.get("shuffle_id", -1)) for s in spans
+                     if span_skew(s) > DOCTOR_SKEW_THRESHOLD})
+    if skewed:
+        worst = max(span_skew(s) for s in spans)
+        findings.append(
+            f"per-peer skew up to {worst:.1f}x (> "
+            f"{DOCTOR_SKEW_THRESHOLD:.0f}x) in shuffle(s) "
+            f"{skewed}: partition sizes are unbalanced — try "
+            'ShuffleConf(geometry_classes="fine") so slot classes track '
+            "actual partition sizes, or a better-spreading partitioner")
+    spills = max((int(s.get("spill_count", 0)) for s in spans), default=0)
+    if spills > 0:
+        findings.append(
+            f"{spills} host-staging spill(s): the slot pool ran out of "
+            "device buffers — warm more classes via ShuffleConf("
+            'prealloc="records:count,...") or raise slot capacity')
+    stalled = sorted({int(e.get("shuffle_id", -1)) for e in stalls})
+    if stalled:
+        findings.append(
+            f"{len(stalls)} watchdog stall report(s) in shuffle(s) "
+            f"{stalled}: a blocking wait exceeded watchdog_timeout_s — "
+            "inspect the journaled stall lines (queue occupancy, pool "
+            "high-water) and the Perfetto trace (scripts/shuffle_trace.py)")
+    retried = sorted({int(s.get("shuffle_id", -1)) for s in spans
+                      if int(s.get("retry_count", 0)) > 0})
+    if retried:
+        findings.append(
+            f"fetch retries in shuffle(s) {retried}: backend failures "
+            "were recovered from checkpoints — check device health; "
+            "raise max_retry_attempts only if failures are transient")
+    if not findings:
+        findings.append("no issues detected: skew, spills, stalls and "
+                        "retries all within normal bounds")
+    return findings
+
+
 def _fmt_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(n) < 1024 or unit == "TiB":
@@ -173,23 +272,66 @@ def print_report(rep: dict, top: int) -> None:
         print("skew report: all spans balanced (max/mean = 1.0)")
 
 
+def print_hosts(hosts_rep: dict) -> None:
+    hosts = hosts_rep["hosts"]
+    print(f"cross-host stragglers ({len(hosts)} hosts):")
+    for sid, agg in hosts_rep["per_shuffle"].items():
+        per_host = agg["per_host_exchange_s"]
+        times = "  ".join(f"h{h}={t:.4f}s" for h, t in per_host.items())
+        print(f"  shuffle {sid}: slowest host {agg['slowest_host']}, "
+              f"spread {agg['spread']:.2f}x   {times}")
+
+
+def print_stalls(stalls: List[dict]) -> None:
+    print(f"watchdog stalls: {len(stalls)} report(s)")
+    for e in stalls:
+        print(f"  shuffle {e.get('shuffle_id')} span {e.get('span_id')}: "
+              f"{e.get('desc', '?')} blocked {e.get('elapsed_s', 0):.2f}s "
+              f"(chunk {e.get('chunk')}, queue {e.get('queue')}, "
+              f"pool high-water {e.get('pool_high_water')})")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Aggregate a sparkrdma_tpu exchange journal")
-    ap.add_argument("journal", help="JSON-lines journal file "
-                    "(ShuffleConf.metrics_sink)")
+        description="Aggregate sparkrdma_tpu exchange journals")
+    ap.add_argument("journals", nargs="+", metavar="journal",
+                    help="JSON-lines journal file(s) "
+                         "(ShuffleConf.metrics_sink; pass one per host "
+                         "when the sink used the {process} placeholder)")
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON instead of text")
     ap.add_argument("--top", type=int, default=3,
                     help="spans to list in the skew report (default 3)")
+    ap.add_argument("--doctor", action="store_true",
+                    help="print rule-based diagnosis (symptom -> knob)")
     args = ap.parse_args(argv)
-    spans = load_spans(args.journal)
+    spans: List[dict] = []
+    stalls: List[dict] = []
+    for path in args.journals:
+        sp, st = split_entries(load_entries(path))
+        spans.extend(sp)
+        stalls.extend(st)
     rep = aggregate(spans)
+    hosts_rep = host_breakdown(spans) if spans else {"hosts": [],
+                                                     "per_shuffle": {}}
+    multi_host = len(hosts_rep["hosts"]) > 1
     if args.json:
+        rep["hosts"] = hosts_rep
+        rep["stall_reports"] = stalls
+        if args.doctor:
+            rep["doctor"] = diagnose(spans, stalls)
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
         print_report(rep, args.top)
+        if multi_host:
+            print_hosts(hosts_rep)
+        if stalls:
+            print_stalls(stalls)
+        if args.doctor:
+            print("doctor:")
+            for line in diagnose(spans, stalls):
+                print(f"  - {line}")
     return 0
 
 

@@ -1,0 +1,245 @@
+#!/usr/bin/env python3
+"""Export exchange journals as Chrome Trace Event Format JSON.
+
+Converts one or more exchange journals (``ShuffleConf.metrics_sink``,
+one JSON line per shuffle read — see ``sparkrdma_tpu/obs/journal.py``)
+into a trace viewable in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``:
+
+- one process track per host (``ExchangeSpan.process_index``), named
+  ``host N`` — multi-host journals written via the ``{process}`` sink
+  placeholder merge into one timeline;
+- per-span phase slices (plan / exchange / sort) as duration events on
+  the host's ``phases`` thread, labelled with span + shuffle id;
+- the span's in-span event timeline (``events`` array, schema v2) as
+  nested duration slices (chunk dispatch/fold, queue blocks, stream
+  prep) and instants (pool acquires, spills, retries, faults) on the
+  ``exchange events`` thread;
+- counter tracks (``pool.outstanding``, ``chunks.outstanding``) from
+  the timeline's C events;
+- journaled ``stall`` lines (the watchdog's flight-recorder reports) as
+  process-scoped instant events.
+
+Clock model: timeline events carry monotonic offsets relative to the
+span's drain point, which coincides with the span's wall-clock ``ts``
+stamp, so event wall time is ``ts - (t_last - t)`` where ``t_last`` is
+the latest offset in the span. Phase slices are reconstructed from the
+phase durations counting back from ``ts`` (sort last, exchange before
+it, plan before that) — contiguous by construction, an approximation
+faithful to within the inter-phase host gaps.
+
+Stdlib only (no jax / numpy): runs anywhere the journal files land.
+
+Usage::
+
+    python scripts/shuffle_trace.py journal.jsonl -o trace.json
+    python scripts/shuffle_trace.py j_0.jsonl j_1.jsonl -o trace.json
+    python scripts/shuffle_trace.py 'journals/j_*.jsonl' -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+US = 1_000_000  # Chrome trace timestamps are microseconds
+
+
+def load_entries(path: str) -> List[dict]:
+    """All JSON-object lines of one journal (spans AND stall lines)."""
+    entries = []
+    with open(path, encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"warning: {path}:{ln}: bad JSON line skipped ({e})",
+                      file=sys.stderr)
+                continue
+            if isinstance(obj, dict):
+                entries.append(obj)
+    return entries
+
+
+def _meta(pid: int, name: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _thread_meta(pid: int, tid: int, name: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": name}}
+
+
+def _phase_slices(span: dict, pid: int) -> List[dict]:
+    """plan / exchange / sort as X slices counting back from span.ts."""
+    ts = float(span.get("ts", 0.0))
+    out = []
+    end = ts
+    label = (f"span {span.get('span_id')} "
+             f"shuffle {span.get('shuffle_id')}")
+    for phase in ("sort_s", "exchange_s", "plan_s"):
+        dur = float(span.get(phase, 0.0) or 0.0)
+        if dur <= 0.0:
+            continue
+        start = end - dur
+        out.append({
+            "ph": "X", "pid": pid, "tid": 1,
+            "name": phase[:-2],  # strip the _s suffix
+            "ts": int(start * US), "dur": int(dur * US),
+            "args": {
+                "label": label,
+                "rounds": span.get("rounds"),
+                "records": span.get("records"),
+            },
+        })
+        end = start
+    return out
+
+
+# timeline event names rendered as process-scoped instants even when
+# they arrive as ph="i" with interesting extras
+_COUNTER_SUFFIX = {"v"}
+
+
+def _timeline_events(span: dict, pid: int) -> List[dict]:
+    """The span's `events` array -> Chrome events on the host's tracks.
+
+    B/E pairs become X slices (matched per-name via a stack, so nested
+    and repeated regions pair correctly); unmatched B events degrade to
+    instants rather than corrupting the track; C events become counter
+    samples; everything else is an instant.
+    """
+    events = span.get("events") or []
+    if not events:
+        return []
+    ts = float(span.get("ts", 0.0))
+    t_last = max(float(e.get("t", 0.0)) for e in events)
+
+    def wall_us(e: dict) -> int:
+        return int((ts - (t_last - float(e.get("t", 0.0)))) * US)
+
+    out: List[dict] = []
+    open_begins: Dict[str, List[Tuple[int, dict]]] = {}
+    for e in events:
+        name = str(e.get("name", "event"))
+        ph = e.get("ph", "i")
+        args = {k: v for k, v in e.items()
+                if k not in ("t", "ph", "name")}
+        if ph == "B":
+            open_begins.setdefault(name, []).append((wall_us(e), args))
+        elif ph == "E":
+            stack = open_begins.get(name)
+            if stack:
+                t0, bargs = stack.pop()
+                bargs.update(args)
+                out.append({"ph": "X", "pid": pid, "tid": 2, "name": name,
+                            "ts": t0, "dur": max(wall_us(e) - t0, 0),
+                            "args": bargs})
+            else:  # E with no B: show it rather than drop it
+                out.append({"ph": "i", "pid": pid, "tid": 2, "name": name,
+                            "ts": wall_us(e), "s": "t", "args": args})
+        elif ph == "C":
+            out.append({"ph": "C", "pid": pid, "name": name,
+                        "ts": wall_us(e),
+                        "args": {"value": e.get("v", 0)}})
+        else:
+            out.append({"ph": "i", "pid": pid, "tid": 2, "name": name,
+                        "ts": wall_us(e), "s": "t", "args": args})
+    # unmatched B events (e.g. a plan() that raised): render as instants
+    for name, stack in open_begins.items():
+        for t0, args in stack:
+            out.append({"ph": "i", "pid": pid, "tid": 2, "name": name,
+                        "ts": t0, "s": "t", "args": args})
+    return out
+
+
+def _stall_event(entry: dict) -> dict:
+    pid = int(entry.get("process_index", 0) or 0)
+    return {
+        "ph": "i", "pid": pid, "tid": 2, "name": "STALL",
+        "ts": int(float(entry.get("ts", 0.0)) * US),
+        "s": "p",  # process-scoped: draw across the host's tracks
+        "args": {k: v for k, v in entry.items() if k not in ("ts", "kind")},
+    }
+
+
+def build_trace(journals: Dict[str, List[dict]]) -> dict:
+    """Merge loaded journals into one Chrome-trace dict.
+
+    ``journals`` maps a source label (file path) to its entry list; host
+    identity comes from each span's ``process_index`` field, not from
+    which file it came from, so both per-host files and a shared sink
+    merge correctly.
+    """
+    trace_events: List[dict] = []
+    hosts_seen: Dict[int, int] = {}
+    for src, entries in journals.items():
+        for entry in entries:
+            kind = entry.get("kind")
+            if kind == "stall":
+                trace_events.append(_stall_event(entry))
+                continue
+            if kind not in (None, "span"):
+                continue  # unknown auxiliary kinds: forward-compat skip
+            span = entry
+            pid = int(span.get("process_index", 0) or 0)
+            if pid not in hosts_seen:
+                hosts_seen[pid] = 1
+                trace_events.append(_meta(pid, f"host {pid}"))
+                trace_events.append(_thread_meta(pid, 1, "phases"))
+                trace_events.append(_thread_meta(pid, 2, "exchange events"))
+            trace_events.extend(_phase_slices(span, pid))
+            trace_events.extend(_timeline_events(span, pid))
+    trace_events.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def _expand(paths: List[str]) -> List[str]:
+    """Glob-expand arguments (quoted globs survive the shell)."""
+    out: List[str] = []
+    for p in paths:
+        matches = sorted(glob.glob(p))
+        out.extend(matches if matches else [p])
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export sparkrdma_tpu exchange journals as a "
+                    "Chrome/Perfetto trace")
+    ap.add_argument("journals", nargs="+",
+                    help="journal files (one per host when the sink used "
+                         "the {process} placeholder); globs accepted")
+    ap.add_argument("-o", "--output", default="-",
+                    help="output trace JSON path (default: stdout)")
+    args = ap.parse_args(argv)
+    journals = {}
+    for path in _expand(args.journals):
+        try:
+            journals[path] = load_entries(path)
+        except OSError as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+    trace = build_trace(journals)
+    n = len(trace["traceEvents"])
+    if args.output == "-":
+        json.dump(trace, sys.stdout)
+        print()
+    else:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        print(f"wrote {n} trace events from {len(journals)} journal(s) "
+              f"to {args.output}\nopen in https://ui.perfetto.dev or "
+              "chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,8 @@ from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.timeline import EventTimeline, set_active
+from sparkrdma_tpu.obs.watchdog import StallWatchdog, install_state_dump
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.profiling import annotate, annotate_span
 from sparkrdma_tpu.utils.stats import (ExchangeRecord, ShuffleReadStats,
@@ -213,6 +215,10 @@ class ShuffleReader:
         # XProf annotations so trace regions and journal lines correlate
         journal_on = self._m.journal.enabled and record_stats
         span_id = next_span_id() if journal_on else 0
+        # stall reports from this read carry the span/shuffle identity so
+        # a journaled `stall` line correlates with its (eventual) span
+        self._m.watchdog.set_context(span_id=span_id,
+                                     shuffle_id=self._h.shuffle_id)
         post_s = 0.0   # separate filter/agg/sort program wall-clock
         attempt = 0
         while True:
@@ -305,6 +311,8 @@ class ShuffleReader:
                     "shuffle %d fetch failed (attempt %d/%d): %s; "
                     "retrying", self._h.shuffle_id, attempt,
                     conf.max_retry_attempts, e)
+                self._m.timeline.event("retry", attempt=attempt,
+                                       shuffle=self._h.shuffle_id)
                 writer = self._m._recover_writer(self._h)
         plan = writer.plan
         if record_stats:
@@ -343,6 +351,11 @@ class ShuffleReader:
                                      if pool is not None else 0),
                     spill_count=spill_count(),
                     retry_count=attempt - 1,
+                    process_index=self._m.runtime.process_index,
+                    host_count=self._m.runtime.process_count,
+                    # drain restarts the timeline clock, so the next
+                    # span's events are relative to this emit
+                    events=self._m.timeline.drain(),
                 ))
         del incoming
         return out, totals
@@ -485,18 +498,38 @@ class ShuffleManager:
         self.metrics = MetricsRegistry(
             enabled=(self.conf.collect_shuffle_read_stats
                      or bool(self.conf.metrics_sink)))
-        self.journal = ExchangeJournal(self.conf.metrics_sink)
+        # multi-host: a shared sink path would interleave hosts' lines;
+        # the {process} placeholder gives each host its own journal file
+        # (merged later by shuffle_report.py / shuffle_trace.py)
+        sink = self.conf.metrics_sink
+        if isinstance(sink, str) and "{process}" in sink:
+            sink = sink.replace("{process}",
+                                str(self.runtime.process_index))
+        self.journal = ExchangeJournal(sink, metrics=self.metrics)
+        # per-span event timeline: events accumulate across plan+read and
+        # drain into the span's `events` array at emit time
+        self.timeline = EventTimeline(enabled=self.journal.enabled)
+        set_active(self.timeline)
+        self.watchdog = StallWatchdog(self.conf.watchdog_timeout_s,
+                                      journal=self.journal,
+                                      metrics=self.metrics,
+                                      timeline=self.timeline)
+        if self.watchdog.enabled:
+            install_state_dump()   # SIGUSR1 armed-wait dump (best effort)
         # the runtime's SlotPool serves exchange recv/output buffers
         # (RdmaBufferManager wiring: the node owns the pool, channels use it)
         if self.runtime.pool is not None:
             self.runtime.pool.metrics = self.metrics
+            self.runtime.pool.timeline = self.timeline
         self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats,
                                       registry=self.metrics)
         self._exchange = ShuffleExchange(self.runtime.mesh,
                                          self.runtime.axis_name, self.conf,
                                          pool=self.runtime.pool,
                                          metrics=self.metrics,
-                                         stats=self.stats)
+                                         stats=self.stats,
+                                         timeline=self.timeline,
+                                         watchdog=self.watchdog)
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
         self._registry = MapOutputRegistry(ids, metrics=self.metrics)

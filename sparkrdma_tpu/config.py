@@ -182,8 +182,22 @@ class ShuffleConf:
     #: per executed shuffle read (schema: sparkrdma_tpu.obs.journal).
     #: Empty = journal off. Enabling the journal also enables the
     #: metrics registry, independent of collect_shuffle_read_stats.
-    #: Aggregate offline with ``python scripts/shuffle_report.py <sink>``.
+    #: Multi-host: a literal ``{process}`` in the path expands to the
+    #: JAX process index at manager construction, so every host writes
+    #: its own journal ("/logs/journal-{process}.jsonl"); feed all of
+    #: them to the report/trace CLIs for a cross-host merge. Aggregate
+    #: offline with ``python scripts/shuffle_report.py <sink>...``;
+    #: export a Perfetto-viewable Chrome trace with
+    #: ``python scripts/shuffle_trace.py <sink>...``.
     metrics_sink: str = ""
+    #: stall watchdog (sparkrdma_tpu.obs.watchdog): a streaming-exchange
+    #: blocking wait exceeding this many seconds logs + journals a
+    #: ``stall`` record with the full in-flight state (shuffle id, chunk
+    #: index, queue occupancy, pool high-water) instead of hanging
+    #: silently. 0 (default) disables. SIGUSR1 dumps currently-armed
+    #: waits on demand. Size it well above a healthy chunk's wall-clock
+    #: — the watchdog observes the wait, it never interrupts it.
+    watchdog_timeout_s: float = 0.0
 
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue
@@ -233,6 +247,8 @@ class ShuffleConf:
                 "(supported: '', 'zlib', 'lzma')")
         if not 0 <= self.compression_level <= 9:
             raise ValueError("compression_level must be in [0, 9]")
+        if self.watchdog_timeout_s < 0:
+            raise ValueError("watchdog_timeout_s must be >= 0 (0 disables)")
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property

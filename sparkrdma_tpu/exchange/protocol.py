@@ -76,6 +76,8 @@ from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
 
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
+from sparkrdma_tpu.obs.timeline import NULL_TIMELINE, EventTimeline
+from sparkrdma_tpu.obs.watchdog import StallWatchdog
 from sparkrdma_tpu.utils.compat import shard_map
 
 
@@ -188,7 +190,9 @@ class ShuffleExchange:
                  conf: Optional[ShuffleConf] = None,
                  pool=None,
                  metrics: Optional[MetricsRegistry] = None,
-                 stats: Optional[ShuffleReadStats] = None):
+                 stats: Optional[ShuffleReadStats] = None,
+                 timeline: Optional[EventTimeline] = None,
+                 watchdog: Optional[StallWatchdog] = None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
@@ -198,6 +202,15 @@ class ShuffleExchange:
         # unconditional (null instruments are no-ops)
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry(enabled=False)
+        # in-span event timeline + stall watchdog (obs layer); both
+        # default to no-ops so instrumentation sites stay unconditional
+        self.timeline = timeline if timeline is not None else NULL_TIMELINE
+        self.watchdog = watchdog if watchdog is not None \
+            else StallWatchdog(self.conf.watchdog_timeout_s)
+        #: test hook: called (with the chunk index) INSIDE the armed
+        #: watchdog region before each streaming queue wait — lets tests
+        #: simulate a wedged collective without wedging a collective
+        self.block_hook: Optional[Callable[[int], None]] = None
         # optional read-stats accumulator so DIRECT exchange users (the
         # ring / hierarchical transport paths driven without a
         # ShuffleManager) still populate ExchangeRecord spans when
@@ -235,10 +248,12 @@ class ShuffleExchange:
         if self.fault_hook is not None:
             if self.fault_hook():
                 self.metrics.counter("exchange.faults").inc()
+                self.timeline.event("fault:injected", shuffle=shuffle_id)
                 raise FetchFailedError(shuffle_id, "injected fault (hook)")
         elif self.conf.fault_injection_rate > 0.0:
             if self._fault_rng.random() < self.conf.fault_injection_rate:
                 self.metrics.counter("exchange.faults").inc()
+                self.timeline.event("fault:injected", shuffle=shuffle_id)
                 raise FetchFailedError(shuffle_id, "injected fault (rate)")
 
     # ------------------------------------------------------------------
@@ -259,6 +274,7 @@ class ShuffleExchange:
         map-output table before issuing READs" step.
         """
         t0 = time.perf_counter()
+        self.timeline.begin("plan")
         num_parts = num_parts or self.mesh_size
         explicit_capacity = capacity
         if num_parts % self.mesh_size:
@@ -332,6 +348,8 @@ class ShuffleExchange:
         self.last_plan_s = time.perf_counter() - t0
         self.metrics.counter("exchange.plans").inc()
         self.metrics.histogram("exchange.plan_s").observe(self.last_plan_s)
+        self.timeline.end("plan", rounds=num_rounds, capacity=capacity,
+                          split=split)
         return ShufflePlan(
             counts=counts,
             num_rounds=num_rounds,
@@ -769,7 +787,8 @@ class ShuffleExchange:
         ))
 
     def _exchange_streaming(self, records, partitioner, plan, num_parts,
-                            sort_key_words, aggregator, float_payload):
+                            sort_key_words, aggregator, float_payload,
+                            shuffle_id=-1):
         """Regime B driver: prep, paced round chunks, folds, tail."""
         conf = self.conf
         w = records.shape[0]
@@ -793,8 +812,11 @@ class ShuffleExchange:
         chunk_fn = cached(("chunk", num_parts, cap, F, w),
                           lambda: self._build_chunk(num_parts, cap, F, w))
 
+        self.timeline.begin("stream:prep", chunks=n_chunks,
+                            rounds=plan.num_rounds)
         sr, counts, offs, incoming, totals = prep(records)
         dispatches = 1
+        self.timeline.end("stream:prep")
 
         # +cap head-room per device so fold windows never clamp
         acc_shape = (w, mesh_size * (plan.out_capacity + cap))
@@ -819,17 +841,32 @@ class ShuffleExchange:
             return zfn()
 
         acc = get_buf(acc_shape, out_sharding)
+        tl = self.timeline
         in_flight = []   # completion tokens of dispatched chunks
         for j in range(n_chunks):
             if len(in_flight) >= conf.queue_depth:
                 # the recvQueueDepth throttle: block on the oldest
-                # outstanding chunk before admitting a new one
+                # outstanding chunk before admitting a new one. This is
+                # THE blocking wait of the streaming regime, so it is
+                # watchdog-armed: a wedged collective fires a journaled
+                # stall record instead of hanging silently.
                 self.metrics.counter("exchange.queue_blocks").inc()
-                jax.block_until_ready(in_flight.pop(0))
+                tl.begin("queue:block", chunk=j)
+                with self.watchdog.armed(
+                        "queue:block", shuffle=shuffle_id, chunk=j,
+                        queue=len(in_flight),
+                        pool_high_water=(self.pool.outstanding_high_water
+                                         if self.pool is not None else 0)):
+                    if self.block_hook is not None:
+                        self.block_hook(j)
+                    jax.block_until_ready(in_flight.pop(0))
+                tl.end("queue:block", chunk=j)
             self.metrics.counter("exchange.stream_chunks").inc()
+            tl.begin("chunk", chunk=j)
             recv_buf = get_buf(recv_shape, recv_sharding)
             r0 = jnp.full((1,), j * F, jnp.int32)
             recv = chunk_fn(sr, counts, offs, r0, recv_buf)
+            tl.event("chunk:dispatch", chunk=j, rounds=F)
             fold = cached(
                 ("fold", num_parts, cap, F, total_rounds,
                  plan.out_capacity, w, j == 0),
@@ -839,6 +876,9 @@ class ShuffleExchange:
             acc, token = fold(acc, recv, incoming, cidx)
             dispatches += 2
             in_flight.append(token)
+            tl.event("chunk:fold", chunk=j)
+            tl.end("chunk", chunk=j)
+            tl.counter("chunks.outstanding", len(in_flight))
             if self.pool is not None:
                 # recv is consumed by the fold already enqueued; returning
                 # it now lets chunk j+1 donate the same pages (the runtime
@@ -851,6 +891,7 @@ class ShuffleExchange:
                           aggregator, float_payload))
         out, totals = tail(acc, totals)
         dispatches += 1
+        tl.event("stream:tail")
         if self.pool is not None:
             # the accumulator is free once the (dispatched) tail read it
             self.pool.put_shaped(acc, out_sharding)
@@ -920,7 +961,8 @@ class ShuffleExchange:
         if plan.num_rounds > self.conf.max_rounds_in_flight:
             return self._exchange_streaming(
                 records, partitioner, plan, num_parts,
-                sort_key_words, aggregator, float_payload)
+                sort_key_words, aggregator, float_payload,
+                shuffle_id=shuffle_id)
         w = records.shape[0]
         # every device's output exactly full -> the fused sort can drop
         # its validity lead operand (static fact from the plan's counts)
@@ -941,19 +983,25 @@ class ShuffleExchange:
             self._exec_cache[key] = fn
         self.last_dispatches = 1
         m.counter("exchange.dispatches").inc()
-        if donate:
-            okey = (shuffle_id, key)
-            sharding = NamedSharding(self.mesh, P(None, self.axis_name))
-            prev = self._out_prev.pop(okey, None)
-            if prev is not None:
-                self.pool.put_shaped(prev[0], prev[1])
-            buf = self.pool.get_shaped(
-                (w, self.mesh_size * plan.out_capacity), jnp.uint32,
-                sharding)
-            out, totals, incoming = fn(records, buf)
-            self._out_prev[okey] = (out, sharding)
-            return out, totals, incoming
-        return fn(records)
+        self.timeline.begin("exchange:fused", rounds=plan.num_rounds)
+        try:
+            if donate:
+                okey = (shuffle_id, key)
+                sharding = NamedSharding(self.mesh, P(None, self.axis_name))
+                prev = self._out_prev.pop(okey, None)
+                if prev is not None:
+                    self.pool.put_shaped(prev[0], prev[1])
+                buf = self.pool.get_shaped(
+                    (w, self.mesh_size * plan.out_capacity), jnp.uint32,
+                    sharding)
+                out, totals, incoming = fn(records, buf)
+                self._out_prev[okey] = (out, sharding)
+                return out, totals, incoming
+            return fn(records)
+        finally:
+            # closes even when the dispatch raises, so the span's
+            # timeline stays balanced across retry attempts
+            self.timeline.end("exchange:fused")
 
     def release_shuffle(self, shuffle_id: int) -> None:
         """Return a shuffle's recycled output buffers to the pool.

@@ -48,10 +48,14 @@ def _count_spill(nbytes: int) -> None:
     folds the cumulative count into each exchange span at emit time.
     """
     from sparkrdma_tpu.obs.metrics import global_registry
+    from sparkrdma_tpu.obs.timeline import record_active
 
     reg = global_registry()
     reg.counter("staging.spills").inc()
     reg.counter("staging.spill_bytes").inc(nbytes)
+    # also a timeline event in whichever manager's span is active, so a
+    # mid-read spill shows up in the journal's events array / the trace
+    record_active("staging:spill", bytes=nbytes)
 
 
 def spill_count() -> int:

@@ -34,8 +34,11 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import time
+
 from sparkrdma_tpu.config import ShuffleConf, size_class
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.timeline import NULL_TIMELINE
 
 
 class Slot:
@@ -110,6 +113,9 @@ class SlotPool:
         # manager runs without metrics
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry(enabled=False)
+        # in-span event timeline (rebound by the owning ShuffleManager):
+        # acquire waits become span events, occupancy a counter track
+        self.timeline = NULL_TIMELINE
         for records, count in self.conf.prealloc_classes().items():
             cls = size_class(records)
             for _ in range(count):
@@ -126,6 +132,7 @@ class SlotPool:
                 self.outstanding_high_water = self.outstanding
             out = self.outstanding
         self.metrics.gauge("pool.outstanding").set(out)
+        self.timeline.counter("pool.outstanding", out)
 
     def _track_in(self) -> None:
         """One buffer came back (pooled OR dropped as donated — either
@@ -135,6 +142,7 @@ class SlotPool:
                 self.outstanding -= 1
             out = self.outstanding
         self.metrics.gauge("pool.outstanding").set(out)
+        self.timeline.counter("pool.outstanding", out)
 
     def _alloc(self, capacity: int, record_words: int) -> jax.Array:
         self.allocations += 1
@@ -159,6 +167,7 @@ class SlotPool:
                 f"size class {cls} for request of {n_records} records > "
                 f"max_slot_records {self.conf.max_slot_records}"
             )
+        t0 = time.perf_counter()
         arr = None
         with self._lock:
             stack = self._free.get((cls, rw))
@@ -169,6 +178,7 @@ class SlotPool:
                     arr = cand
                     break
                 self.donated_dropped += 1
+        hit = arr is not None
         if arr is None:
             self.misses += 1
             self.metrics.counter("pool.misses").inc()
@@ -176,6 +186,8 @@ class SlotPool:
         else:
             self.hits += 1
             self.metrics.counter("pool.hits").inc()
+        self.timeline.event("pool:acquire", hit=hit,
+                            wait_s=round(time.perf_counter() - t0, 6))
         self._track_out()
         return Slot(arr, cls, rw, self)
 
@@ -205,6 +217,7 @@ class SlotPool:
         already bounds the number of distinct geometries.
         """
         key = ("shaped", tuple(shape), jnp.dtype(dtype).name, sharding)
+        t0 = time.perf_counter()
         arr = None
         with self._lock:
             stack = self._free.get(key)
@@ -214,6 +227,7 @@ class SlotPool:
                     arr = cand
                     break
                 self.donated_dropped += 1
+        hit = arr is not None
         if arr is None:
             self.misses += 1
             self.allocations += 1
@@ -229,6 +243,11 @@ class SlotPool:
         else:
             self.hits += 1
             self.metrics.counter("pool.hits").inc()
+        # the acquire "wait": a miss pays device alloc + zero-fill
+        # dispatch, a hit only the stack pop — the difference is the
+        # pool's contribution to the span's wall-clock
+        self.timeline.event("pool:acquire", hit=hit,
+                            wait_s=round(time.perf_counter() - t0, 6))
         self._track_out()
         return arr
 

@@ -1,8 +1,11 @@
-"""Unified observability: metrics registry, exchange journal, read stats.
+"""Unified observability: metrics, journal, timeline, watchdog, stats.
 
 See :mod:`sparkrdma_tpu.obs.metrics` for the registry contract,
-:mod:`sparkrdma_tpu.obs.journal` for the JSON-lines exchange journal, and
-``scripts/shuffle_report.py`` for the offline aggregator.
+:mod:`sparkrdma_tpu.obs.journal` for the JSON-lines exchange journal,
+:mod:`sparkrdma_tpu.obs.timeline` for the bounded in-span event recorder,
+:mod:`sparkrdma_tpu.obs.watchdog` for the stall watchdog,
+``scripts/shuffle_report.py`` for the offline aggregator and
+``scripts/shuffle_trace.py`` for the Chrome-trace (Perfetto) exporter.
 """
 
 from sparkrdma_tpu.obs.journal import (
@@ -10,6 +13,7 @@ from sparkrdma_tpu.obs.journal import (
     ExchangeJournal,
     ExchangeSpan,
     next_span_id,
+    read_entries,
     read_journal,
 )
 from sparkrdma_tpu.obs.metrics import (
@@ -21,11 +25,24 @@ from sparkrdma_tpu.obs.metrics import (
     set_global_registry,
 )
 from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
+from sparkrdma_tpu.obs.timeline import (
+    NULL_TIMELINE,
+    EventTimeline,
+    record_active,
+    set_active,
+)
+from sparkrdma_tpu.obs.watchdog import (
+    StallWatchdog,
+    dump_armed,
+    install_state_dump,
+)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "global_registry", "set_global_registry",
-    "ExchangeJournal", "ExchangeSpan", "read_journal", "next_span_id",
-    "SCHEMA_VERSION",
+    "ExchangeJournal", "ExchangeSpan", "read_journal", "read_entries",
+    "next_span_id", "SCHEMA_VERSION",
+    "EventTimeline", "NULL_TIMELINE", "set_active", "record_active",
+    "StallWatchdog", "dump_armed", "install_state_dump",
     "ExchangeRecord", "ShuffleReadStats",
 ]

@@ -10,7 +10,10 @@ to answer "which exchange round, which peer, which pool is slow" offline:
 - identity: monotonically increasing ``span_id`` (also threaded into the
   ``jax.profiler`` annotation names via
   :func:`sparkrdma_tpu.utils.profiling.annotate_span`, so XProf trace
-  regions and journal lines correlate by id), ``shuffle_id``, transport;
+  regions and journal lines correlate by id), ``shuffle_id``, transport,
+  and — multi-host — ``process_index`` / ``host_count`` so journals from
+  every host merge without ambiguity (each host writes its own file via
+  the ``{process}`` placeholder in ``metrics_sink``);
 - phase wall-clocks: ``plan_s`` / ``exchange_s`` / ``sort_s`` (sort is
   0.0 when fused into the exchange program — the full-range default);
 - volume: ``rounds``, ``dispatches``, ``records``, ``record_bytes``,
@@ -19,9 +22,27 @@ to answer "which exchange round, which peer, which pool is slow" offline:
   (the ``RdmaShuffleReaderStats`` per-remote-executor table, machine-
   readable);
 - pressure: slot-pool occupancy high-water, cumulative host-staging
-  spill count, retry count.
+  spill count, retry count;
+- **timeline** (schema v2): ``events`` — the bounded in-span event array
+  drained from :class:`~sparkrdma_tpu.obs.timeline.EventTimeline`
+  (per-chunk dispatch/queue-block/fold, pool acquires, spills, retries,
+  stalls), convertible to a Perfetto-viewable Chrome trace with
+  ``scripts/shuffle_trace.py``.
 
-Aggregate with ``scripts/shuffle_report.py``.
+Besides spans, a journal may carry **auxiliary lines** tagged with a
+``"kind"`` field — today ``{"kind": "stall", ...}`` records written by
+:mod:`sparkrdma_tpu.obs.watchdog` while a read is still blocked (the
+read's own span only ever lands if the wait completes).
+:func:`read_journal` returns spans only; :func:`read_entries` returns
+everything.
+
+Schema compatibility contract (pinned by tests): readers drop unknown
+keys and default missing ones, so a v1 line parses under the v2 reader
+(``events`` empty, single-host identity) and a v2 line parses under a
+v1-era reader (the timeline is simply invisible to it).
+
+Aggregate with ``scripts/shuffle_report.py``; export traces with
+``scripts/shuffle_trace.py``.
 """
 
 from __future__ import annotations
@@ -29,11 +50,15 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import logging
 import threading
 import time
-from typing import IO, List, Optional, Union
+from typing import IO, Dict, List, Optional, Union
 
-SCHEMA_VERSION = 1
+log = logging.getLogger("sparkrdma_tpu.journal")
+
+#: v2: + ``events`` timeline, + ``process_index``/``host_count`` identity
+SCHEMA_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -58,6 +83,11 @@ class ExchangeSpan:
     pool_high_water: int = 0
     spill_count: int = 0
     retry_count: int = 0
+    # --- multi-host identity (schema v2) ---
+    process_index: int = 0
+    host_count: int = 1
+    # --- in-span event timeline (schema v2); see obs/timeline.py ---
+    events: List[Dict] = dataclasses.field(default_factory=list)
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 
@@ -72,6 +102,8 @@ class ExchangeSpan:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExchangeSpan":
+        # forward/backward compat: unknown keys dropped, missing keys
+        # defaulted — the v1 <-> v2 contract (see module docstring)
         fields = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in fields})
 
@@ -97,14 +129,24 @@ class ExchangeJournal:
     or idle journal leaves no artifact), a file-like object (tests,
     in-memory capture), or None/"" (disabled: :meth:`emit` is a no-op
     and no I/O ever happens).
+
+    **A journal failure must never kill a shuffle**: the first
+    ``OSError`` on open/write disables the sink, logs once, and bumps
+    ``journal.write_errors`` in ``metrics`` (when provided); the read
+    that triggered it — and every later read — completes normally,
+    journal-less. Observability is a passenger, not a copilot.
     """
 
-    def __init__(self, sink: Union[str, IO[str], None] = None):
+    def __init__(self, sink: Union[str, IO[str], None] = None,
+                 metrics=None):
         self._path: Optional[str] = None
         self._fh: Optional[IO[str]] = None
         self._own_fh = False
         self._lock = threading.Lock()
+        self._metrics = metrics
         self.emitted = 0
+        #: write failures observed (after the first, the sink is dead)
+        self.write_errors = 0
         if sink is None or sink == "":
             pass
         elif isinstance(sink, str):
@@ -121,32 +163,84 @@ class ExchangeJournal:
     def emit(self, span: ExchangeSpan) -> None:
         if not self.enabled:
             return
-        line = json.dumps(span.to_dict(), separators=(",", ":"))
+        self._write_line(span.to_dict())
+
+    def emit_raw(self, entry: dict) -> None:
+        """Append an auxiliary (non-span) line — MUST carry ``"kind"``.
+
+        The watchdog's stall records use this; :func:`read_journal`
+        skips such lines, :func:`read_entries` surfaces them.
+        """
+        if not self.enabled:
+            return
+        if "kind" not in entry:
+            raise ValueError("auxiliary journal lines must carry 'kind'")
+        self._write_line(entry)
+
+    def _write_line(self, d: dict) -> None:
+        line = json.dumps(d, separators=(",", ":"))
         with self._lock:
-            if self._fh is None:
-                self._fh = open(self._path, "a", encoding="utf-8")
-                self._own_fh = True
-            self._fh.write(line + "\n")
-            self._fh.flush()
-            self.emitted += 1
+            try:
+                if self._fh is None:
+                    self._fh = open(self._path, "a", encoding="utf-8")
+                    self._own_fh = True
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self.emitted += 1
+            except OSError as e:
+                # disable on first failure: one loud log line, then the
+                # journal goes quiet instead of failing every read
+                self.write_errors += 1
+                log.error("journal sink failed (%s); journaling disabled "
+                          "for this manager", e)
+                if self._own_fh and self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                self._fh = None
+                self._path = None
+                self._own_fh = False
+                if self._metrics is not None:
+                    self._metrics.counter("journal.write_errors").inc()
 
     def close(self) -> None:
+        """Close owned sinks; flush (but never close) borrowed ones.
+
+        Registered at manager shutdown (``ShuffleManager.stop``) so
+        buffered file-like sinks are flushed even when the process exits
+        without another emit.
+        """
         with self._lock:
-            if self._fh is not None and self._own_fh:
-                self._fh.close()
-                self._fh = None
+            if self._fh is None:
+                return
+            try:
+                if self._own_fh:
+                    self._fh.close()
+                    self._fh = None
+                else:
+                    self._fh.flush()
+            except OSError:
+                pass
 
 
-def read_journal(path: str) -> List[ExchangeSpan]:
-    """Parse a journal file back into spans (blank lines skipped)."""
-    spans = []
+def read_entries(path: str) -> List[dict]:
+    """Parse every journal line (spans AND auxiliary records) as dicts."""
+    entries = []
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if line:
-                spans.append(ExchangeSpan.from_dict(json.loads(line)))
-    return spans
+                entries.append(json.loads(line))
+    return entries
+
+
+def read_journal(path: str) -> List[ExchangeSpan]:
+    """Parse a journal file back into spans (blank lines skipped;
+    auxiliary ``kind``-tagged lines — stall records — skipped too)."""
+    return [ExchangeSpan.from_dict(d) for d in read_entries(path)
+            if d.get("kind") in (None, "span")]
 
 
 __all__ = ["ExchangeSpan", "ExchangeJournal", "read_journal",
-           "next_span_id", "SCHEMA_VERSION"]
+           "read_entries", "next_span_id", "SCHEMA_VERSION"]

@@ -1,0 +1,171 @@
+"""Stall watchdog — a hung exchange must produce a signal, not silence.
+
+The streaming exchange regime blocks the host on completion tokens
+(``jax.block_until_ready`` on chunk ``j - queue_depth`` before admitting
+chunk ``j``). A wedged collective — a peer process died, a DCN link
+flapped, a deadlocked donation chain — turns that wait into an indefinite
+silent hang: no log line, no journal span, nothing for an operator to
+grep. The reference has the same failure mode (a lost completion leaves
+``RdmaShuffleFetcherIterator`` parked on its results queue forever) and
+the same lack of tooling.
+
+:class:`StallWatchdog` closes the gap. The exchange arms it around every
+blocking wait; if the wait exceeds ``ShuffleConf.watchdog_timeout_s`` the
+watchdog — from a timer thread, while the wait keeps waiting —
+
+- logs the full in-flight state (shuffle id, chunk index, queue
+  occupancy, pool high-water) at ERROR;
+- appends a ``{"kind": "stall", ...}`` line to the exchange journal, so
+  the stall is machine-visible even though the read's own span will only
+  ever be written if the wait eventually completes;
+- records a ``stall`` event on the in-span timeline and bumps the
+  ``watchdog.stalls`` counter.
+
+The wait itself is NOT interrupted: killing a collective mid-flight would
+corrupt the donation chain, and the retry layer above already maps real
+backend failures to ``FetchFailedError``. The watchdog is a flight
+recorder, not a circuit breaker.
+
+**On-demand state dump**: :func:`install_state_dump` registers a
+``SIGUSR1`` handler (where the platform has one) that dumps every
+currently-armed wait via :func:`dump_armed` — ``kill -USR1 <pid>``
+answers "what is this job blocked on right now" without restarting it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import logging
+import signal
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+log = logging.getLogger("sparkrdma_tpu.watchdog")
+
+# process-wide table of currently-armed waits, for the SIGUSR1 dump —
+# every StallWatchdog registers here while armed
+_armed_lock = threading.Lock()
+_armed: Dict[int, Dict] = {}
+_armed_ids = itertools.count(1)
+
+
+class StallWatchdog:
+    """Arms a timer around blocking waits; fires once per stalled wait.
+
+    ``timeout_s <= 0`` disables the watchdog entirely: :meth:`armed`
+    yields immediately with no timer, no registration, no overhead —
+    the null-instrument convention of :mod:`sparkrdma_tpu.obs.metrics`.
+    """
+
+    def __init__(self, timeout_s: float = 0.0, journal=None, metrics=None,
+                 timeline=None):
+        self.timeout_s = timeout_s
+        self.journal = journal
+        self.metrics = metrics
+        self.timeline = timeline
+        #: stalls fired over this watchdog's lifetime
+        self.stall_count = 0
+        #: state dict of the most recent stall (None = never stalled)
+        self.last_stall: Optional[Dict] = None
+        # per-read context (span id, shuffle id) merged into stall
+        # records; the SPI layer refreshes it at the top of each read
+        self._context: Dict = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def set_context(self, **kw) -> None:
+        """Attach per-read identity (span_id, shuffle_id) to stalls."""
+        self._context = dict(kw)
+
+    @contextlib.contextmanager
+    def armed(self, desc: str, **state) -> Iterator[None]:
+        """Guard one blocking wait; fire if it outlives ``timeout_s``."""
+        if not self.enabled:
+            yield
+            return
+        record = dict(self._context)
+        record.update(state)
+        record["desc"] = desc
+        record["armed_at"] = time.time()
+        wid = next(_armed_ids)
+        with _armed_lock:
+            _armed[wid] = record
+        timer = threading.Timer(self.timeout_s, self._fire, args=(record,))
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+            with _armed_lock:
+                _armed.pop(wid, None)
+
+    def _fire(self, record: Dict) -> None:
+        """Timer callback: the armed wait is officially a stall."""
+        stall = dict(record)
+        stall["kind"] = "stall"
+        stall["elapsed_s"] = round(time.time() - stall.pop("armed_at"),
+                                   6)
+        stall["ts"] = time.time()
+        self.stall_count += 1
+        self.last_stall = stall
+        log.error("shuffle stall: blocked > %.3fs in %s (%s)",
+                  self.timeout_s, stall.get("desc"),
+                  ", ".join(f"{k}={v}" for k, v in sorted(stall.items())
+                            if k not in ("desc", "kind", "ts")))
+        if self.metrics is not None:
+            self.metrics.counter("watchdog.stalls").inc()
+        if self.timeline is not None:
+            self.timeline.event("stall", **{
+                k: v for k, v in stall.items()
+                if k not in ("kind", "ts", "desc")})
+        if self.journal is not None:
+            self.journal.emit_raw(stall)
+
+
+def dump_armed(sink=None) -> List[Dict]:
+    """Snapshot (and log) every currently-armed blocking wait.
+
+    Returns the snapshot so tests and embedders can assert on it;
+    ``sink`` overrides the logger (any callable taking one string).
+    """
+    emit = sink if sink is not None else log.warning
+    with _armed_lock:
+        snapshot = [dict(v) for v in _armed.values()]
+    now = time.time()
+    if not snapshot:
+        emit("watchdog state dump: no blocking waits armed")
+        return snapshot
+    for rec in snapshot:
+        emit("watchdog state dump: %s armed %.3fs ago (%s)" % (
+            rec.get("desc"), now - rec.get("armed_at", now),
+            ", ".join(f"{k}={v}" for k, v in sorted(rec.items())
+                      if k not in ("desc", "armed_at"))))
+    return snapshot
+
+
+def install_state_dump(signum: Optional[int] = None) -> bool:
+    """Register the on-demand state dump on ``SIGUSR1`` (or ``signum``).
+
+    Returns True when installed. Degrades to False — never raises — on
+    platforms without SIGUSR1 or when called off the main thread
+    (signal.signal's own restriction), so the SPI layer can attempt the
+    install unconditionally.
+    """
+    if signum is None:
+        signum = getattr(signal, "SIGUSR1", None)
+        if signum is None:
+            return False
+    try:
+        signal.signal(signum, lambda _sig, _frm: dump_armed())
+        return True
+    except (ValueError, OSError, RuntimeError):
+        # non-main thread, or an embedder that owns signal handling
+        return False
+
+
+__all__ = ["StallWatchdog", "dump_armed", "install_state_dump"]

@@ -100,6 +100,22 @@ class MeshRuntime:
     def devices(self) -> Tuple[jax.Device, ...]:
         return tuple(self.mesh.devices.flat)
 
+    @property
+    def process_index(self) -> int:
+        """This process's rank in the multi-host job (0 single-host).
+
+        The host half of :class:`ManagerId` — stamped into every journal
+        span (``ExchangeSpan.process_index``) and into per-host journal
+        file names via the ``{process}`` placeholder in
+        ``ShuffleConf.metrics_sink``.
+        """
+        return int(jax.process_index())
+
+    @property
+    def process_count(self) -> int:
+        """Number of host processes in the job (1 single-host)."""
+        return int(jax.process_count())
+
     def manager_id(self, device_index: int) -> ManagerId:
         d = self.devices[device_index]
         return ManagerId(process_index=d.process_index, device_index=device_index)

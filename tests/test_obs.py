@@ -29,8 +29,9 @@ from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.obs import (ExchangeJournal, ExchangeSpan, Histogram,
                                MetricsRegistry, ShuffleReadStats,
-                               global_registry, next_span_id, read_journal,
-                               set_global_registry)
+                               global_registry, next_span_id, read_entries,
+                               read_journal, set_global_registry)
+from sparkrdma_tpu.obs.journal import SCHEMA_VERSION
 from sparkrdma_tpu.utils.stats import barrier
 
 REPO = Path(__file__).resolve().parent.parent
@@ -152,7 +153,7 @@ class TestJournal:
                          retry_count=1, pool_high_water=4, spill_count=2)
         d = span.to_dict()
         assert d["total_bytes"] == span.records * span.record_bytes
-        assert d["schema"] == 1
+        assert d["schema"] == 2
         back = ExchangeSpan.from_dict(d)
         assert back == span
 
@@ -208,6 +209,188 @@ class TestJournal:
     def test_span_ids_monotone(self):
         a, b, c = next_span_id(), next_span_id(), next_span_id()
         assert a < b < c
+
+
+#: the exact field set a schema-v1 journal line carried (PR 1); the
+#: cross-version tests below pin the v1 <-> v2 compat contract to it
+V1_FIELDS = ("span_id", "shuffle_id", "transport", "rounds", "dispatches",
+             "records", "record_bytes", "plan_s", "exchange_s", "sort_s",
+             "per_peer_records", "pool_high_water", "spill_count",
+             "retry_count", "ts", "schema", "total_bytes")
+
+
+class TestSchemaVersioning:
+    def test_schema_version_is_two(self):
+        assert SCHEMA_VERSION == 2
+        assert make_span().schema == 2
+
+    def test_v1_line_parses_under_v2_reader(self):
+        """A journal written before the timeline existed still reads:
+        v2-only fields default (empty events, single-host identity) and
+        the line's own schema stamp is preserved."""
+        v1_line = {
+            "span_id": 5, "shuffle_id": 2, "transport": "xla",
+            "rounds": 3, "dispatches": 1, "records": 100,
+            "record_bytes": 16, "plan_s": 0.1, "exchange_s": 0.2,
+            "sort_s": 0.0, "per_peer_records": [25, 25, 25, 25],
+            "pool_high_water": 2, "spill_count": 0, "retry_count": 0,
+            "ts": 1700000000.0, "schema": 1, "total_bytes": 1600,
+        }
+        span = ExchangeSpan.from_dict(v1_line)
+        assert span.schema == 1
+        assert span.events == []
+        assert span.process_index == 0 and span.host_count == 1
+        assert span.records == 100 and span.rounds == 3
+
+    def test_v2_line_parses_under_v1_reader(self):
+        """The v1 reader was the same drop-unknown-keys from_dict over a
+        smaller field set; emulate it and feed it a v2 line. Every v1
+        field must still be present on a v2 line (no rename/removal),
+        and the v2-only fields must be exactly the droppable extras."""
+        d = make_span(process_index=1, host_count=2,
+                      events=[{"t": 0.1, "ph": "i", "name": "x"}]).to_dict()
+        missing = [f for f in V1_FIELDS if f not in d]
+        assert not missing, f"v2 line lost v1 fields: {missing}"
+        v1_view = {k: v for k, v in d.items() if k in V1_FIELDS}
+        span = ExchangeSpan.from_dict(v1_view)   # what a v1 reader builds
+        assert span.records == d["records"]
+        assert span.per_peer_records == d["per_peer_records"]
+
+
+class _ExplodingSink(io.StringIO):
+    """File-like sink that fails after ``good`` successful writes."""
+
+    def __init__(self, good: int = 0):
+        super().__init__()
+        self._good = good
+
+    def write(self, s):
+        if self._good <= 0:
+            raise OSError(28, "No space left on device")
+        self._good -= 1
+        return super().write(s)
+
+
+class TestJournalHardening:
+    def test_emit_failure_never_raises_and_disables_sink(self):
+        reg = MetricsRegistry()
+        j = ExchangeJournal(_ExplodingSink(good=0), metrics=reg)
+        j.emit(make_span())                   # must not raise
+        assert j.write_errors == 1
+        assert not j.enabled, "first failure kills the sink"
+        assert reg.counter("journal.write_errors").value == 1
+        j.emit(make_span())                   # dead sink: silent no-op
+        assert j.write_errors == 1 and j.emitted == 0
+        j.close()
+
+    def test_emit_failure_on_unwritable_path(self, tmp_path):
+        j = ExchangeJournal(str(tmp_path / "no" / "such" / "dir" / "j.jsonl"))
+        j.emit(make_span())                   # open() fails -> disabled
+        assert j.write_errors == 1 and not j.enabled
+        j.close()
+
+    def test_emit_raw_requires_kind(self):
+        j = ExchangeJournal(io.StringIO())
+        with pytest.raises(ValueError):
+            j.emit_raw({"elapsed_s": 1.0})
+        j.emit_raw({"kind": "stall", "shuffle_id": 1})
+        j.close()
+
+    def test_read_journal_skips_aux_lines(self, tmp_path):
+        path = tmp_path / "mixed.jsonl"
+        j = ExchangeJournal(str(path))
+        j.emit(make_span(span_id=1))
+        j.emit_raw({"kind": "stall", "shuffle_id": 0, "span_id": 2})
+        j.emit(make_span(span_id=3))
+        j.close()
+        spans = read_journal(str(path))
+        assert [s.span_id for s in spans] == [1, 3]
+        entries = read_entries(str(path))
+        assert len(entries) == 3
+        assert entries[1]["kind"] == "stall"
+
+    def test_close_registered_at_manager_stop(self, tmp_path):
+        """stop() must flush borrowed sinks (buffered writers would
+        otherwise lose the tail of the journal on exit)."""
+        flushed = []
+
+        class Sink(io.StringIO):
+            def flush(self):
+                flushed.append(True)
+                return super().flush()
+
+        conf = ShuffleConf(slot_records=64)
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        manager.journal = ExchangeJournal(Sink())
+        manager.journal.emit(make_span())
+        manager.stop()
+        assert flushed, "manager.stop() must flush the journal sink"
+
+
+class TestMultiJournalReport:
+    """Cross-host merge + straggler section + --doctor rules."""
+
+    def _host_journal(self, tmp_path, host, exchange_s, **kw):
+        path = tmp_path / f"j_{host}.jsonl"
+        j = ExchangeJournal(str(path))
+        j.emit(make_span(span_id=10 + host, shuffle_id=0,
+                         process_index=host, host_count=2,
+                         exchange_s=exchange_s, **kw))
+        j.close()
+        return path
+
+    def test_multi_journal_merge_and_stragglers(self, tmp_path, capsys):
+        p0 = self._host_journal(tmp_path, 0, exchange_s=0.1)
+        p1 = self._host_journal(tmp_path, 1, exchange_s=0.4)
+        assert shuffle_report.main([str(p0), str(p1)]) == 0
+        text = capsys.readouterr().out
+        assert "2 spans across 1 shuffles" in text
+        assert "cross-host stragglers (2 hosts)" in text
+        assert "slowest host 1" in text
+        assert "spread 4.00x" in text
+
+    def test_host_breakdown_json(self, tmp_path, capsys):
+        p0 = self._host_journal(tmp_path, 0, exchange_s=0.2)
+        p1 = self._host_journal(tmp_path, 1, exchange_s=0.2)
+        assert shuffle_report.main([str(p0), str(p1), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["hosts"]["hosts"] == [0, 1]
+        sh = rep["hosts"]["per_shuffle"]["0"]
+        assert sh["spread"] == pytest.approx(1.0)
+
+    def test_doctor_skew_rule(self):
+        # 4 peers cap max/mean at 4.0 exactly; 8 peers with one hot
+        # spot give 93/12.5 = 7.4x — solidly past the 4x threshold
+        spans = [make_span(shuffle_id=4,
+                           peers=(93, 1, 1, 1, 1, 1, 1, 1)).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any("geometry_classes" in f and "[4]" in f
+                   for f in findings)
+
+    def test_doctor_spill_rule(self):
+        spans = [make_span(spill_count=3).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any("prealloc" in f for f in findings)
+
+    def test_doctor_stall_and_retry_rules(self):
+        spans = [make_span(shuffle_id=7, retry_count=2).to_dict()]
+        stalls = [{"kind": "stall", "shuffle_id": 9, "elapsed_s": 2.0}]
+        findings = shuffle_report.diagnose(spans, stalls)
+        assert any("stall" in f and "[9]" in f for f in findings)
+        assert any("retries" in f and "[7]" in f for f in findings)
+
+    def test_doctor_healthy(self):
+        spans = [make_span().to_dict()]
+        assert shuffle_report.diagnose(spans, []) == [
+            "no issues detected: skew, spills, stalls and retries all "
+            "within normal bounds"]
+
+    def test_doctor_cli_flag(self, tmp_path, capsys):
+        p0 = self._host_journal(tmp_path, 0, exchange_s=0.1,
+                                peers=(93, 1, 1, 1, 1, 1, 1, 1))
+        assert shuffle_report.main([str(p0), "--doctor"]) == 0
+        text = capsys.readouterr().out
+        assert "doctor:" in text and "geometry_classes" in text
 
 
 class TestShuffleReport:
@@ -286,7 +469,7 @@ class TestManagerJournalE2E:
         manager, plan = self._run_shuffle(conf, rng)
         (span,) = read_journal(str(sink))
         assert span.shuffle_id == 90
-        assert span.schema == 1
+        assert span.schema == 2
         assert span.transport == conf.transport
         assert span.rounds == plan.num_rounds
         assert span.records == plan.total_records
