@@ -23,8 +23,8 @@ measured on. ``value_width_optimal`` reports the measured per-chip GB/s
 peak of the width curve (52B records) alongside, labeled as such; round
 4 benched the optimum silently, which the round-4 verdict called out.
 
-Record width (v5e width study, rounds 4-5 — scripts/profile9.py,
-profile8.py, profile11.py, profile12.py): round 4 concluded from
+Record width (v5e width study, rounds 4-5 — scripts/profile_sweep.py,
+the width/wide/pack/ab suites): round 4 concluded from
 standalone piece timings that wide records must not ride the comparator
 (ride/gather split, 2.69 GB/s at 100B). Round 5's fused A/Bs overturned
 that: the plain monolithic variadic sort, fused into the exchange
@@ -38,8 +38,14 @@ ShuffleConf.pack_sort_min_payload).
 Env knobs: BENCH_RECORDS_PER_DEVICE (default 16M), BENCH_REPEATS
 (default 16), BENCH_RECORD_WORDS (set to run ONE explicit width instead
 of the faithful+optimal pair).
+
+``--journal PATH`` routes the run's exchange journal (spans + rollup
+windows; ``{process}`` placeholder supported) to PATH, so a bench run
+leaves the same telemetry a production run would — inspect it with
+``scripts/shuffle_report.py`` / ``shuffle_top.py`` / ``shuffle_trace.py``.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -66,7 +72,7 @@ def _bench_metrics(manager) -> dict:
 
 
 def run_width(record_words: int, records_per_device: int,
-              repeats: int):
+              repeats: int, journal: str = ""):
     """One full bench leg at ``record_words``; returns ``(gbps, metrics)``
     — GB/s per chip (negative on verification failure) plus the
     observability summary embedded in the bench JSON."""
@@ -89,6 +95,8 @@ def run_width(record_words: int, records_per_device: int,
     # for wide records because it caps compile time for arbitrary user
     # geometries — see ShuffleConf.pack_sort_min_payload's policy note.
     kw = {"pack_sort_min_payload": 0, "wide_sort_min_payload": 0}
+    if journal:
+        kw["metrics_sink"] = journal   # spans + rollups land here
     pack_min = os.environ.get("BENCH_PACK_MIN_PAYLOAD")
     if pack_min is not None:       # A/B hook for the packing threshold
         kw["pack_sort_min_payload"] = int(pack_min)
@@ -126,7 +134,14 @@ def run_width(record_words: int, records_per_device: int,
         manager.stop()
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="TeraSort shuffle throughput bench (one JSON line)")
+    ap.add_argument("--journal", default="", metavar="PATH",
+                    help="write the exchange journal (spans + rollup "
+                         "windows) to PATH; {process} expands to the "
+                         "process index on multi-host runs")
+    args = ap.parse_args(argv)
     # 16M records/chip: the log^2 sort amortizes better over larger
     # batches, and 16M measured optimal in the round-4 batch sweep
     # (8M/12M/24M all score lower GB/s)
@@ -155,7 +170,7 @@ def main() -> int:
 
     if explicit_words:
         gbps, metrics = run_width(int(explicit_words), records_per_device,
-                                  repeats)
+                                  repeats, journal=args.journal)
         if gbps < 0:
             print(json.dumps({"error": "device verification FAILED"}))
             return 1
@@ -171,11 +186,13 @@ def main() -> int:
 
     # faithful HiBench width (100B) is the judged number; the width-curve
     # optimum (52B) is reported alongside, labeled
-    faithful, metrics = run_width(25, records_per_device, repeats)
+    faithful, metrics = run_width(25, records_per_device, repeats,
+                                  journal=args.journal)
     if faithful < 0:   # fail fast: don't spend the second leg's minutes
         print(json.dumps({"error": "device verification FAILED"}))
         return 1
-    optimal, _ = run_width(13, records_per_device, repeats)
+    optimal, _ = run_width(13, records_per_device, repeats,
+                           journal=args.journal)
     if optimal < 0:
         print(json.dumps({"error": "device verification FAILED"}))
         return 1

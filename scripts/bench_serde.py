@@ -22,8 +22,11 @@ wire format, what the fabric actually moves — same accounting as the
 reference's compressed-block GB/s).
 
 Env: BENCH_RECORDS_PER_DEVICE (default 8M), BENCH_REPEATS (default 8).
+``--journal PATH`` routes the run's exchange journal (spans + rollup
+windows) to PATH for ``shuffle_report.py`` / ``shuffle_top.py``.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -58,7 +61,13 @@ def expected_payload(hi: int, lo: int) -> bytes:
     return (pat * 12)[:ln]
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serde-encoded shuffle bench (one JSON line)")
+    ap.add_argument("--journal", default="", metavar="PATH",
+                    help="write the exchange journal (spans + rollup "
+                         "windows) to PATH")
+    args = ap.parse_args(argv)
     n = int(os.environ.get("BENCH_RECORDS_PER_DEVICE", 8 * 1024 * 1024))
     repeats = int(os.environ.get("BENCH_REPEATS", 8))
     rng = np.random.default_rng(7)
@@ -88,7 +97,8 @@ def main() -> int:
                        val_words=w - 2, geometry_classes="fine",
                        # stats ride only the final recorded read; the
                        # timed loop stays record_stats=False (see bench.py)
-                       collect_shuffle_read_stats=True)
+                       collect_shuffle_read_stats=True,
+                       metrics_sink=args.journal)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
         records = manager.runtime.shard_records(rows)

@@ -14,10 +14,13 @@ Three invariants keep the CPU tier-1 suite honest:
    least one ``pytest.mark.slow``, so ``-m 'not slow'`` actually excludes
    the multi-process tests it promises to exclude.
 3. **Journal schema sync** — every span field the offline CLIs
-   (``scripts/shuffle_report.py``, ``scripts/shuffle_trace.py``) read
-   via ``s.get("...")`` / ``span.get("...")`` must exist on
-   ``ExchangeSpan``. The CLIs are stdlib-only and never import the
-   dataclass, so a schema rename would otherwise silently turn their
+   (``scripts/shuffle_report.py``, ``scripts/shuffle_trace.py``,
+   ``scripts/shuffle_top.py``) read via ``s.get("...")`` /
+   ``span.get("...")`` must exist on ``ExchangeSpan``, and every rollup
+   / heartbeat field they read via ``rb.get("...")`` / ``hb.get("...")``
+   must exist in ``obs.rollup.ROLLUP_FIELDS`` / ``HEARTBEAT_FIELDS``.
+   The CLIs are stdlib-only and never import the dataclass or the
+   field sets, so a schema rename would otherwise silently turn their
    reads into defaults instead of failing.
 
 Static checks only read source; the import check executes module tops,
@@ -67,35 +70,51 @@ def check_slow_marked(path: Path) -> str:
 
 
 #: CLI scripts whose span-field reads must match the dataclass
-SPAN_READERS = ("shuffle_report.py", "shuffle_trace.py")
+SPAN_READERS = ("shuffle_report.py", "shuffle_trace.py", "shuffle_top.py")
 
 #: span-field access pattern the lint recognizes; by convention the CLIs
 #: bind a span dict to ``s`` or ``span`` before reading fields from it
 SPAN_GET = re.compile(r'\b(?:s|span)\.get\(\s*"([A-Za-z0-9_]+)"')
 
+#: rollup / heartbeat access patterns; by convention the CLIs bind a
+#: rollup dict to ``rb`` and a heartbeat dict to ``hb``
+ROLLUP_GET = re.compile(r'\brb\.get\(\s*"([A-Za-z0-9_]+)"')
+HEARTBEAT_GET = re.compile(r'\bhb\.get\(\s*"([A-Za-z0-9_]+)"')
+
 
 def check_span_schema_sync() -> str:
-    """Span fields read by the CLIs must exist on ExchangeSpan; '' if so.
+    """CLI journal-field reads must exist in the emitting schema; '' if so.
 
-    ``total_bytes`` (a derived property serialized by ``to_dict``) and
-    ``kind`` (the auxiliary-line tag, absent on spans by design) are
-    allowed on top of the dataclass fields.
+    Spans: ``total_bytes`` (a derived property serialized by ``to_dict``)
+    and ``kind`` (the auxiliary-line tag, absent on spans by design) are
+    allowed on top of the dataclass fields. Rollup and heartbeat lines
+    are checked against the frozen field sets their emitters assert on
+    (``obs.rollup.ROLLUP_FIELDS`` / ``HEARTBEAT_FIELDS``), so emitter
+    and reader drift in either direction fails loudly.
     """
     import dataclasses
 
     from sparkrdma_tpu.obs.journal import ExchangeSpan
+    from sparkrdma_tpu.obs.rollup import HEARTBEAT_FIELDS, ROLLUP_FIELDS
 
-    allowed = ({f.name for f in dataclasses.fields(ExchangeSpan)}
-               | {"total_bytes", "kind"})
+    span_allowed = ({f.name for f in dataclasses.fields(ExchangeSpan)}
+                    | {"total_bytes", "kind"})
+    checks = (
+        (SPAN_GET, span_allowed, "span", "ExchangeSpan"),
+        (ROLLUP_GET, ROLLUP_FIELDS, "rollup", "obs.rollup.ROLLUP_FIELDS"),
+        (HEARTBEAT_GET, HEARTBEAT_FIELDS, "heartbeat",
+         "obs.rollup.HEARTBEAT_FIELDS"),
+    )
     bad = []
     for script in SPAN_READERS:
         src = (REPO / "scripts" / script).read_text(encoding="utf-8")
-        for m in SPAN_GET.finditer(src):
-            if m.group(1) not in allowed:
-                bad.append(f"scripts/{script} reads span field "
-                           f"{m.group(1)!r} which does not exist on "
-                           "ExchangeSpan — rename the field or fix the "
-                           "script")
+        for pattern, allowed, what, where in checks:
+            for m in pattern.finditer(src):
+                if m.group(1) not in allowed:
+                    bad.append(f"scripts/{script} reads {what} field "
+                               f"{m.group(1)!r} which does not exist in "
+                               f"{where} — rename the field or fix the "
+                               "script")
     return "\n".join(bad)
 
 

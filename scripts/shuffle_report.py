@@ -15,8 +15,17 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
 - cross-host stragglers: with several journals (one per host via the
   ``{process}`` sink placeholder), the slowest host per shuffle and the
   per-host exchange-time spread;
+- rollup windows (``{"kind": "rollup"}`` lines, schema v3): exact
+  per-shuffle aggregates that survive span sampling — when present they
+  are the authoritative totals, and sampled span counts are reported as
+  scaled-up *estimates* (each kept span carries ``sample_weight``);
+- heartbeats (``{"kind": "heartbeat"}``): last-seen liveness per host;
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
   stalls, retries) to the ShuffleConf knob that addresses them.
+
+Rotated journals (``j.jsonl.1``, ``.2``, … from
+``ShuffleConf.journal_max_bytes``) are walked automatically — pass the
+live file, the segments are found next to it.
 
 Stdlib only (no jax / numpy): runs anywhere the journal file lands,
 including hosts with no accelerator stack installed.
@@ -34,34 +43,68 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Dict, List, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def rotated_paths(path: str) -> List[str]:
+    """Existing segments of a rotated journal, oldest-first, live last.
+
+    Mirrors ``sparkrdma_tpu.obs.journal.rotated_paths`` (this CLI must
+    stay importable with no package on the path)."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
 
 
 def load_entries(path: str) -> List[dict]:
-    """All JSON-object lines: spans AND auxiliary (``kind``) lines."""
+    """All JSON-object lines: spans AND auxiliary (``kind``) lines.
+
+    Walks rotated segments (``path.N``) oldest-first before the live
+    file; corrupt lines (truncated tail of a killed process) are skipped
+    with a warning, never fatal."""
     entries = []
-    with open(path, encoding="utf-8") as f:
-        for ln, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError as e:
-                print(f"warning: {path}:{ln}: bad JSON line skipped ({e})",
-                      file=sys.stderr)
-                continue
-            if isinstance(obj, dict):
-                entries.append(obj)
+    for p in rotated_paths(path):
+        with open(p, encoding="utf-8", errors="replace") as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError as e:
+                    print(f"warning: {p}:{ln}: bad JSON line skipped ({e})",
+                          file=sys.stderr)
+                    continue
+                if isinstance(obj, dict):
+                    entries.append(obj)
     return entries
 
 
+def split_kinds(entries: List[dict]) -> Dict[str, List[dict]]:
+    """Bucket journal lines by kind; unknown kinds are dropped (forward
+    compat: a v4 journal must not break a v3 report)."""
+    out: Dict[str, List[dict]] = {
+        "span": [], "stall": [], "rollup": [], "heartbeat": []}
+    for e in entries:
+        k = e.get("kind") or "span"
+        if k in out:
+            out[k].append(e)
+    return out
+
+
 def split_entries(entries: List[dict]) -> Tuple[List[dict], List[dict]]:
-    """Partition journal lines into (spans, stalls); drop unknown kinds."""
-    spans = [e for e in entries if e.get("kind") in (None, "span")]
-    stalls = [e for e in entries if e.get("kind") == "stall"]
-    return spans, stalls
+    """Partition journal lines into (spans, stalls); drop other kinds."""
+    kinds = split_kinds(entries)
+    return kinds["span"], kinds["stall"]
 
 
 def load_spans(path: str) -> List[dict]:
@@ -128,8 +171,28 @@ def aggregate(spans: List[dict]) -> dict:
          for s in spans),
         key=lambda d: d["skew"], reverse=True)
     wall = sum(phases.values())
+    # sampling correction (schema v3): a span kept by the 1/N rule
+    # stands for sample_weight reads; scaled sums are ESTIMATES of the
+    # unsampled totals (rollup lines, when present, are the exact ones)
+    est_reads = 0
+    est_records = 0
+    est_bytes = 0
+    for s in spans:
+        w = int(s.get("sample_weight", 1) or 1)
+        est_reads += w
+        est_records += w * int(s.get("records", 0))
+        est_bytes += w * int(s.get("total_bytes",
+                                   s.get("records", 0)
+                                   * s.get("record_bytes", 0)))
+    sampling = {
+        "sampled": est_reads > len(spans),
+        "estimated_reads": est_reads,
+        "estimated_records": est_records,
+        "estimated_bytes": est_bytes,
+    }
     return {
         "spans": len(spans),
+        "sampling": sampling,
         "shuffles": len(per_shuffle),
         "total_records": total_records,
         "total_bytes": total_bytes,
@@ -149,6 +212,108 @@ def aggregate(spans: List[dict]) -> dict:
             for k, v in sorted(per_shuffle.items())},
         "skew": skews,
     }
+
+
+def _bucket_quantile(bounds: Sequence[float], buckets: Sequence[int],
+                     q: float, hi: Optional[float] = None) -> float:
+    """Quantile estimate from a fixed-bucket histogram (stdlib copy of
+    ``sparkrdma_tpu.obs.metrics.bucket_quantile``; merged rollup windows
+    need it because per-window p95 values cannot be averaged)."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = min(max(q, 0.0), 1.0) * total
+    seen = 0.0
+    est = float(hi if hi is not None else bounds[-1])
+    for i, n in enumerate(buckets):
+        if n <= 0:
+            continue
+        if seen + n >= rank:
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i] if i < len(bounds) else (
+                hi if hi is not None else bounds[-1])
+            upper = max(upper, lower)
+            est = lower + (upper - lower) * ((rank - seen) / n)
+            break
+        seen += n
+    return min(est, hi) if hi is not None else est
+
+
+def aggregate_rollups(rollups: List[dict]) -> dict:
+    """Fold rollup windows into exact totals (overall + per shuffle).
+
+    These counts cover EVERY read — sampled-away spans included — so
+    when both spans and rollups are present the rollup totals win."""
+    if not rollups:
+        return {"windows": 0}
+    sums = {"reads": 0, "sampled_reads": 0, "records": 0, "bytes": 0,
+            "rounds": 0, "dispatches": 0, "retries": 0, "spills": 0,
+            "streaming_reads": 0, "fused_reads": 0}
+    per_shuffle: Dict[int, dict] = {}
+    bounds: Optional[List[float]] = None
+    merged: Optional[List[int]] = None
+    lat_max = 0.0
+    for rb in rollups:
+        sid = int(rb.get("shuffle_id", -1))
+        cell = per_shuffle.setdefault(sid, {k: 0 for k in sums})
+        for k in sums:
+            v = int(rb.get(k, 0) or 0)
+            sums[k] += v
+            cell[k] += v
+        b = rb.get("lat_bounds_ms")
+        bk = rb.get("lat_buckets")
+        if b and bk:
+            if bounds is None or list(b) == list(bounds):
+                bounds = list(b)
+                if merged is None:
+                    merged = [0] * len(bk)
+                for i, n in enumerate(bk):
+                    if i < len(merged):
+                        merged[i] += int(n)
+        lat_max = max(lat_max, float(rb.get("lat_max_ms", 0.0) or 0.0))
+    out = dict(sums)
+    out["windows"] = len(rollups)
+    out["shuffles"] = len(per_shuffle)
+    out["per_shuffle"] = {str(k): v
+                          for k, v in sorted(per_shuffle.items())}
+    out["lat_max_ms"] = round(lat_max, 3)
+    if bounds and merged:
+        out["p50_ms"] = round(
+            _bucket_quantile(bounds, merged, 0.50, hi=lat_max), 3)
+        out["p95_ms"] = round(
+            _bucket_quantile(bounds, merged, 0.95, hi=lat_max), 3)
+        out["p99_ms"] = round(
+            _bucket_quantile(bounds, merged, 0.99, hi=lat_max), 3)
+    return out
+
+
+def heartbeat_summary(heartbeats: List[dict],
+                      now: Optional[float] = None) -> dict:
+    """Latest heartbeat per (process, host): liveness at a glance."""
+    now = time.time() if now is None else now
+    latest: Dict[Tuple[int, str], dict] = {}
+    for hb in heartbeats:
+        key = (int(hb.get("process_index", 0) or 0),
+               str(hb.get("host", "?")))
+        cur = latest.get(key)
+        if cur is None or float(hb.get("ts", 0) or 0) >= float(
+                cur.get("ts", 0) or 0):
+            latest[key] = hb
+    hosts = []
+    for (pi, host), hb in sorted(latest.items()):
+        ts = float(hb.get("ts", now) or now)
+        hosts.append({
+            "process_index": pi,
+            "host": host,
+            "pid": hb.get("pid"),
+            "beats": hb.get("seq"),
+            "uptime_s": hb.get("uptime_s"),
+            "in_flight": hb.get("in_flight"),
+            "pool_outstanding": hb.get("pool_outstanding"),
+            "rss_mb": hb.get("rss_mb"),
+            "age_s": round(max(now - ts, 0.0), 3),
+        })
+    return {"hosts": hosts}
 
 
 def host_breakdown(spans: List[dict]) -> dict:
@@ -241,6 +406,13 @@ def print_report(rep: dict, top: int) -> None:
         return
     print(f"exchange journal report — {rep['spans']} spans across "
           f"{rep['shuffles']} shuffles")
+    samp = rep.get("sampling") or {}
+    if samp.get("sampled"):
+        print(f"  journal is SAMPLED: {rep['spans']} full spans kept of "
+              f"~{samp['estimated_reads']:,} reads — sampling-corrected "
+              f"estimates: ~{samp['estimated_records']:,} records, "
+              f"~{_fmt_bytes(samp['estimated_bytes'])} "
+              "(rollup windows below are exact)")
     print(f"  records: {rep['total_records']:,}   "
           f"bytes: {_fmt_bytes(rep['total_bytes'])}   "
           f"rounds: {rep['rounds']}   dispatches: {rep['dispatches']}")
@@ -282,6 +454,35 @@ def print_hosts(hosts_rep: dict) -> None:
               f"spread {agg['spread']:.2f}x   {times}")
 
 
+def print_rollups(roll: dict) -> None:
+    print(f"rollup windows: {roll['windows']} across {roll['shuffles']} "
+          "shuffles (exact totals, sampling-independent):")
+    print(f"  reads: {roll['reads']:,} ({roll['streaming_reads']} "
+          f"streaming / {roll['fused_reads']} fused; "
+          f"{roll['sampled_reads']} kept as full spans)   "
+          f"records: {roll['records']:,}   "
+          f"bytes: {_fmt_bytes(roll['bytes'])}")
+    print(f"  retries: {roll['retries']}   spills: {roll['spills']}   "
+          f"read latency p50/p95/p99: {roll.get('p50_ms', 0):.1f} / "
+          f"{roll.get('p95_ms', 0):.1f} / {roll.get('p99_ms', 0):.1f} ms "
+          f"(max {roll['lat_max_ms']:.1f})")
+    for sid, c in roll["per_shuffle"].items():
+        print(f"  shuffle {sid}: {c['reads']:,} reads, "
+              f"{c['records']:,} records, {_fmt_bytes(c['bytes'])}, "
+              f"{c['retries']} retries, {c['spills']} spills")
+
+
+def print_heartbeats(hb_rep: dict) -> None:
+    print(f"heartbeats ({len(hb_rep['hosts'])} host(s), latest per host):")
+    for h in hb_rep["hosts"]:
+        rss = (f", rss {h['rss_mb']:.0f} MiB"
+               if isinstance(h.get("rss_mb"), (int, float)) else "")
+        print(f"  proc {h['process_index']} ({h['host']} pid "
+              f"{h['pid']}): {h['beats']} beats, up {h['uptime_s']}s, "
+              f"last seen {h['age_s']:.1f}s ago, in-flight "
+              f"{h['in_flight']}, pool {h['pool_outstanding']}{rss}")
+
+
 def print_stalls(stalls: List[dict]) -> None:
     print(f"watchdog stalls: {len(stalls)} report(s)")
     for e in stalls:
@@ -307,23 +508,35 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     spans: List[dict] = []
     stalls: List[dict] = []
+    rollups: List[dict] = []
+    heartbeats: List[dict] = []
     for path in args.journals:
-        sp, st = split_entries(load_entries(path))
-        spans.extend(sp)
-        stalls.extend(st)
+        kinds = split_kinds(load_entries(path))
+        spans.extend(kinds["span"])
+        stalls.extend(kinds["stall"])
+        rollups.extend(kinds["rollup"])
+        heartbeats.extend(kinds["heartbeat"])
     rep = aggregate(spans)
     hosts_rep = host_breakdown(spans) if spans else {"hosts": [],
                                                      "per_shuffle": {}}
+    roll_rep = aggregate_rollups(rollups)
+    hb_rep = heartbeat_summary(heartbeats)
     multi_host = len(hosts_rep["hosts"]) > 1
     if args.json:
         rep["hosts"] = hosts_rep
         rep["stall_reports"] = stalls
+        rep["rollups"] = roll_rep
+        rep["heartbeats"] = hb_rep
         if args.doctor:
             rep["doctor"] = diagnose(spans, stalls)
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
         print_report(rep, args.top)
+        if roll_rep.get("windows"):
+            print_rollups(roll_rep)
+        if hb_rep["hosts"]:
+            print_heartbeats(hb_rep)
         if multi_host:
             print_hosts(hosts_rep)
         if stalls:

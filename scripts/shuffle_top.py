@@ -1,0 +1,376 @@
+#!/usr/bin/env python3
+"""Live terminal monitor for exchange journals — ``top`` for shuffles.
+
+Tails one or more exchange journals (``ShuffleConf.metrics_sink``; pass
+per-host files or a ``{process}``-expanded glob) and renders a refreshing
+two-table view:
+
+- **hosts**: one row per process — heartbeat age (``STALE`` flag past
+  ``--stale`` seconds), in-flight reads, pool outstanding, RSS, reads/s
+  and MB/s over the recent rate window, span p95 latency, spills, stalls;
+- **shuffles**: one row per shuffle id — reads (sampling-corrected when
+  the journal was written with ``ShuffleConf.journal_sample``), records,
+  bytes, p95 latency, spills, retries.
+
+Rotated segments (``journal.jsonl.1``, … from
+``ShuffleConf.journal_max_bytes``) are discovered and merged
+automatically, so rotation under the monitor never loses history.
+
+Rates and staleness use the journal's own wall clock: ``now`` is the
+newest ``ts`` seen across all entries, so a finished (static) journal
+renders sensibly with ``--once`` instead of showing everything stale.
+Pass ``--wall`` to judge staleness against the real wall clock when
+watching a live run.
+
+Stdlib only (no jax / numpy, no sparkrdma_tpu import): runs on any
+machine the journal files land on.
+
+Usage::
+
+    python scripts/shuffle_top.py journal.jsonl            # refresh loop
+    python scripts/shuffle_top.py 'j_*.jsonl' --once       # one snapshot
+    python scripts/shuffle_top.py j.jsonl --interval 5 --stale 30 --wall
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def rotated_paths(path: str) -> List[str]:
+    """Existing rotated segments of ``path`` oldest-first, live file last
+    (stdlib mirror of ``sparkrdma_tpu.obs.journal.rotated_paths``)."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
+def load_entries(path: str) -> List[dict]:
+    """All JSON-object lines of one journal, rotated segments included.
+
+    Corrupt or truncated lines (a crash mid-write, a rotation race) are
+    skipped — a monitor must never die on the telemetry it watches.
+    """
+    entries: List[dict] = []
+    for p in rotated_paths(path):
+        try:
+            f = open(p, encoding="utf-8", errors="replace")
+        except OSError:
+            continue  # segment rotated away between listdir and open
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict):
+                    entries.append(obj)
+    return entries
+
+
+def _expand(patterns: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in patterns:
+        matches = sorted(glob.glob(p))
+        out.extend(matches if matches else [p])
+    return out
+
+
+def collect(paths: List[str]) -> Dict[str, List[dict]]:
+    """Bucket every entry of every journal by kind (span/stall/rollup/
+    heartbeat); unknown kinds are dropped (forward compat)."""
+    kinds: Dict[str, List[dict]] = {
+        "span": [], "stall": [], "rollup": [], "heartbeat": []}
+    for path in paths:
+        for entry in load_entries(path):
+            kind = entry.get("kind") or "span"
+            if kind in kinds:
+                kinds[kind].append(entry)
+    return kinds
+
+
+def span_latency_ms(s: dict) -> float:
+    """Same latency the journal's sampler and rollups use."""
+    return (float(s.get("exchange_s", 0.0) or 0.0)
+            + float(s.get("sort_s", 0.0) or 0.0)) * 1e3
+
+
+def _p95(values: List[float]) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, int(0.95 * (len(values) - 1) + 0.999999))
+    return values[idx]
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _fmt_age(age: Optional[float]) -> str:
+    if age is None:
+        return "-"
+    if age < 120.0:
+        return f"{age:.1f}s"
+    return f"{age / 60.0:.1f}m"
+
+
+class HostRow:
+    __slots__ = ("process_index", "host", "pid", "hb_age", "in_flight",
+                 "pool_outstanding", "rss_mb", "reads", "est_reads",
+                 "reads_s", "mb_s", "p95_ms", "spills", "stalls", "stale")
+
+    def __init__(self, process_index: int):
+        self.process_index = process_index
+        self.host = "?"
+        self.pid = 0
+        self.hb_age: Optional[float] = None
+        self.in_flight = 0
+        self.pool_outstanding = 0
+        self.rss_mb: Optional[float] = None
+        self.reads = 0
+        self.est_reads = 0
+        self.reads_s = 0.0
+        self.mb_s = 0.0
+        self.p95_ms = 0.0
+        self.spills = 0
+        self.stalls = 0
+        self.stale = False
+
+
+def build_host_rows(
+    kinds: Dict[str, List[dict]],
+    now: float,
+    stale_s: float,
+    rate_window_s: float,
+) -> List[HostRow]:
+    rows: Dict[int, HostRow] = {}
+
+    def row(pidx: int) -> HostRow:
+        if pidx not in rows:
+            rows[pidx] = HostRow(pidx)
+        return rows[pidx]
+
+    # newest heartbeat per process wins
+    latest_hb: Dict[int, dict] = {}
+    for hb in kinds["heartbeat"]:
+        pidx = int(hb.get("process_index", 0) or 0)
+        if pidx not in latest_hb or float(hb.get("ts", 0.0)) >= float(
+                latest_hb[pidx].get("ts", 0.0)):
+            latest_hb[pidx] = hb
+    for pidx, hb in latest_hb.items():
+        r = row(pidx)
+        r.host = str(hb.get("host", "?"))
+        r.pid = int(hb.get("pid", 0) or 0)
+        r.hb_age = max(0.0, now - float(hb.get("ts", 0.0)))
+        r.in_flight = int(hb.get("in_flight", 0) or 0)
+        r.pool_outstanding = int(hb.get("pool_outstanding", 0) or 0)
+        rss = hb.get("rss_mb")
+        r.rss_mb = float(rss) if isinstance(rss, (int, float)) else None
+        r.stale = r.hb_age > stale_s
+
+    lat: Dict[int, List[float]] = {}
+    recent_bytes: Dict[int, float] = {}
+    recent_reads: Dict[int, int] = {}
+    max_spill: Dict[int, int] = {}
+    for s in kinds["span"]:
+        pidx = int(s.get("process_index", 0) or 0)
+        r = row(pidx)
+        r.reads += 1
+        r.est_reads += int(s.get("sample_weight", 1) or 1)
+        lat.setdefault(pidx, []).append(span_latency_ms(s))
+        # spill_count is process-cumulative: the newest span carries the total
+        max_spill[pidx] = max(max_spill.get(pidx, 0),
+                              int(s.get("spill_count", 0) or 0))
+        if float(s.get("ts", 0.0)) >= now - rate_window_s:
+            recent_reads[pidx] = recent_reads.get(pidx, 0) + int(
+                s.get("sample_weight", 1) or 1)
+            recent_bytes[pidx] = recent_bytes.get(pidx, 0.0) + float(
+                s.get("total_bytes", 0) or 0) * int(
+                    s.get("sample_weight", 1) or 1)
+    for pidx, vals in lat.items():
+        rows[pidx].p95_ms = _p95(vals)
+    for pidx, n in recent_reads.items():
+        rows[pidx].reads_s = n / rate_window_s
+    for pidx, b in recent_bytes.items():
+        rows[pidx].mb_s = b / rate_window_s / (1024.0 * 1024.0)
+    for pidx, n in max_spill.items():
+        rows[pidx].spills = n
+
+    for st in kinds["stall"]:
+        row(int(st.get("process_index", 0) or 0)).stalls += 1
+
+    # rollup windows cover sampled-out spans: take the better rate estimate
+    win_bytes: Dict[int, float] = {}
+    win_reads: Dict[int, int] = {}
+    for rb in kinds["rollup"]:
+        pidx = int(rb.get("process_index", 0) or 0)
+        row(pidx)
+        ws = float(rb.get("window_start", 0.0) or 0.0)
+        if ws + float(rb.get("window_s", 0.0) or 0.0) >= now - rate_window_s:
+            win_reads[pidx] = win_reads.get(pidx, 0) + int(
+                rb.get("reads", 0) or 0)
+            win_bytes[pidx] = win_bytes.get(pidx, 0.0) + float(
+                rb.get("bytes", 0) or 0)
+    for pidx in rows:
+        if pidx in win_reads:
+            rows[pidx].reads_s = max(
+                rows[pidx].reads_s, win_reads[pidx] / rate_window_s)
+            rows[pidx].mb_s = max(
+                rows[pidx].mb_s,
+                win_bytes.get(pidx, 0.0) / rate_window_s / (1024.0 * 1024.0))
+
+    return [rows[k] for k in sorted(rows)]
+
+
+def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
+    """Per-shuffle totals; rollup windows preferred (they see sampled-out
+    spans exactly), raw spans fill in what rollups don't carry."""
+    shuffles: Dict[int, dict] = {}
+
+    def cell(sid: int) -> dict:
+        if sid not in shuffles:
+            shuffles[sid] = {"shuffle_id": sid, "reads": 0, "records": 0,
+                            "bytes": 0, "spills": 0, "retries": 0,
+                            "lat": [], "p95_ms": 0.0, "exact": False}
+        return shuffles[sid]
+
+    for rb in kinds["rollup"]:
+        c = cell(int(rb.get("shuffle_id", 0) or 0))
+        c["exact"] = True
+        c["reads"] += int(rb.get("reads", 0) or 0)
+        c["records"] += int(rb.get("records", 0) or 0)
+        c["bytes"] += int(rb.get("bytes", 0) or 0)
+        c["spills"] += int(rb.get("spills", 0) or 0)
+        c["retries"] += int(rb.get("retries", 0) or 0)
+        c["p95_ms"] = max(c["p95_ms"], float(rb.get("p95_ms", 0.0) or 0.0))
+
+    for s in kinds["span"]:
+        c = cell(int(s.get("shuffle_id", 0) or 0))
+        c["lat"].append(span_latency_ms(s))
+        if not c["exact"]:  # no rollups in this journal: estimate from spans
+            w = int(s.get("sample_weight", 1) or 1)
+            c["reads"] += w
+            c["records"] += int(s.get("records", 0) or 0) * w
+            c["bytes"] += int(s.get("total_bytes", 0) or 0) * w
+            c["retries"] += int(s.get("retry_count", 0) or 0)
+
+    for c in shuffles.values():
+        if not c["exact"] and c["lat"]:
+            c["p95_ms"] = _p95(c["lat"])
+        del c["lat"]
+    return [shuffles[k] for k in sorted(shuffles)]
+
+
+def render(
+    kinds: Dict[str, List[dict]],
+    now: float,
+    stale_s: float,
+    rate_window_s: float,
+) -> str:
+    hosts = build_host_rows(kinds, now, stale_s, rate_window_s)
+    shuffles = build_shuffle_rows(kinds)
+    n_spans = len(kinds["span"])
+    est = sum(int(s.get("sample_weight", 1) or 1) for s in kinds["span"])
+    lines = []
+    sampled = " (sampled: ~%d reads)" % est if est > n_spans else ""
+    lines.append(
+        f"shuffle_top — {len(hosts)} host(s), {len(shuffles)} shuffle(s), "
+        f"{n_spans} spans{sampled}, {len(kinds['rollup'])} rollup window(s), "
+        f"{len(kinds['stall'])} stall(s)")
+    lines.append("")
+    lines.append(f"{'HOST':>4}  {'NAME':<14} {'PID':>7} {'HB AGE':>7} "
+                 f"{'INFL':>4} {'POOL':>4} {'RSS':>8} {'READS/S':>8} "
+                 f"{'MB/S':>8} {'P95MS':>8} {'SPILL':>5} {'STALL':>5}  FLAGS")
+    for r in hosts:
+        rss = f"{r.rss_mb:.0f}MiB" if r.rss_mb is not None else "-"
+        flags = "STALE" if r.stale else ""
+        lines.append(
+            f"{r.process_index:>4}  {r.host[:14]:<14} {r.pid:>7} "
+            f"{_fmt_age(r.hb_age):>7} {r.in_flight:>4} "
+            f"{r.pool_outstanding:>4} {rss:>8} {r.reads_s:>8.2f} "
+            f"{r.mb_s:>8.2f} {r.p95_ms:>8.1f} {r.spills:>5} "
+            f"{r.stalls:>5}  {flags}")
+    if not hosts:
+        lines.append("  (no entries yet)")
+    lines.append("")
+    lines.append(f"{'SHUFFLE':>7}  {'READS':>8} {'RECORDS':>12} "
+                 f"{'BYTES':>10} {'P95MS':>8} {'SPILL':>5} {'RETRY':>5}  SRC")
+    for c in shuffles:
+        src = "rollup" if c["exact"] else "spans"
+        lines.append(
+            f"{c['shuffle_id']:>7}  {c['reads']:>8} {c['records']:>12} "
+            f"{_fmt_bytes(float(c['bytes'])):>10} {c['p95_ms']:>8.1f} "
+            f"{c['spills']:>5} {c['retries']:>5}  {src}")
+    return "\n".join(lines)
+
+
+def journal_now(kinds: Dict[str, List[dict]]) -> float:
+    """Newest wall-clock stamp across all entries (0.0 when empty)."""
+    now = 0.0
+    for entries in kinds.values():
+        for e in entries:
+            now = max(now, float(e.get("ts", 0.0) or 0.0))
+    return now
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live monitor for sparkrdma_tpu exchange journals")
+    ap.add_argument("journals", nargs="+",
+                    help="journal files (globs accepted; rotated segments "
+                         "are merged automatically)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit (no refresh loop)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds (default 2)")
+    ap.add_argument("--stale", type=float, default=15.0,
+                    help="flag a host STALE when its newest heartbeat is "
+                         "older than this many seconds (default 15)")
+    ap.add_argument("--rate-window", type=float, default=10.0,
+                    help="window for reads/s and MB/s rates (default 10s)")
+    ap.add_argument("--wall", action="store_true",
+                    help="judge heartbeat staleness against the real wall "
+                         "clock instead of the journal's newest timestamp")
+    args = ap.parse_args(argv)
+
+    def snapshot() -> str:
+        kinds = collect(_expand(args.journals))
+        now = time.time() if args.wall else journal_now(kinds)
+        return render(kinds, now, args.stale, args.rate_window)
+
+    if args.once:
+        print(snapshot())
+        return 0
+    try:
+        while True:
+            frame = snapshot()
+            # ANSI clear + home: a real refresh, not an endless scroll
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

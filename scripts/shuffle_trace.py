@@ -18,7 +18,16 @@ into a trace viewable in Perfetto (https://ui.perfetto.dev) or
 - counter tracks (``pool.outstanding``, ``chunks.outstanding``) from
   the timeline's C events;
 - journaled ``stall`` lines (the watchdog's flight-recorder reports) as
-  process-scoped instant events.
+  process-scoped instant events;
+- journaled ``heartbeat`` lines (schema v3) as per-host counter tracks
+  (``reads.in_flight``, ``pool.outstanding (hb)``, ``rss_mb``) and
+  ``rollup`` lines as windowed counter tracks (``rollup reads``,
+  ``rollup p95_ms``) — the long-run telemetry rendered on the same
+  timeline as the spans it summarizes.
+
+Rotated journal segments (``j.jsonl.1``, … from
+``ShuffleConf.journal_max_bytes``) are discovered and walked
+automatically when the live file is passed.
 
 Clock model: timeline events carry monotonic offsets relative to the
 span's drain point, which coincides with the span's wall-clock ``ts``
@@ -42,28 +51,45 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
 US = 1_000_000  # Chrome trace timestamps are microseconds
 
 
+def rotated_paths(path: str) -> List[str]:
+    """Existing rotated segments of ``path`` oldest-first, live file last
+    (stdlib mirror of ``sparkrdma_tpu.obs.journal.rotated_paths``)."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 def load_entries(path: str) -> List[dict]:
-    """All JSON-object lines of one journal (spans AND stall lines)."""
+    """All JSON-object lines of one journal (spans AND auxiliary lines),
+    rotated segments included; corrupt lines skipped, never fatal."""
     entries = []
-    with open(path, encoding="utf-8") as f:
-        for ln, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError as e:
-                print(f"warning: {path}:{ln}: bad JSON line skipped ({e})",
-                      file=sys.stderr)
-                continue
-            if isinstance(obj, dict):
-                entries.append(obj)
+    for p in rotated_paths(path):
+        with open(p, encoding="utf-8", errors="replace") as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError as e:
+                    print(f"warning: {p}:{ln}: bad JSON line skipped ({e})",
+                          file=sys.stderr)
+                    continue
+                if isinstance(obj, dict):
+                    entries.append(obj)
     return entries
 
 
@@ -170,6 +196,36 @@ def _stall_event(entry: dict) -> dict:
     }
 
 
+def _heartbeat_events(hb: dict) -> List[dict]:
+    """One heartbeat line -> counter samples on its host's track."""
+    pid = int(hb.get("process_index", 0) or 0)
+    ts = int(float(hb.get("ts", 0.0)) * US)
+    out = [
+        {"ph": "C", "pid": pid, "name": "reads.in_flight", "ts": ts,
+         "args": {"value": hb.get("in_flight", 0)}},
+        {"ph": "C", "pid": pid, "name": "pool.outstanding (hb)", "ts": ts,
+         "args": {"value": hb.get("pool_outstanding", 0)}},
+    ]
+    rss = hb.get("rss_mb")
+    if isinstance(rss, (int, float)):
+        out.append({"ph": "C", "pid": pid, "name": "rss_mb", "ts": ts,
+                    "args": {"value": rss}})
+    return out
+
+
+def _rollup_events(rb: dict) -> List[dict]:
+    """One rollup window -> counter samples at the window's emit time."""
+    pid = int(rb.get("process_index", 0) or 0)
+    ts = int(float(rb.get("ts", 0.0)) * US)
+    sid = rb.get("shuffle_id")
+    return [
+        {"ph": "C", "pid": pid, "name": f"rollup reads (shuffle {sid})",
+         "ts": ts, "args": {"value": rb.get("reads", 0)}},
+        {"ph": "C", "pid": pid, "name": f"rollup p95_ms (shuffle {sid})",
+         "ts": ts, "args": {"value": rb.get("p95_ms", 0)}},
+    ]
+
+
 def build_trace(journals: Dict[str, List[dict]]) -> dict:
     """Merge loaded journals into one Chrome-trace dict.
 
@@ -185,6 +241,12 @@ def build_trace(journals: Dict[str, List[dict]]) -> dict:
             kind = entry.get("kind")
             if kind == "stall":
                 trace_events.append(_stall_event(entry))
+                continue
+            if kind == "heartbeat":
+                trace_events.extend(_heartbeat_events(entry))
+                continue
+            if kind == "rollup":
+                trace_events.extend(_rollup_events(entry))
                 continue
             if kind not in (None, "span"):
                 continue  # unknown auxiliary kinds: forward-compat skip

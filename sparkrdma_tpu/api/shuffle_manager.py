@@ -48,6 +48,7 @@ from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.rollup import HeartbeatEmitter, RollupAggregator, span_latency_ms
 from sparkrdma_tpu.obs.timeline import EventTimeline, set_active
 from sparkrdma_tpu.obs.watchdog import StallWatchdog, install_state_dump
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
@@ -207,6 +208,15 @@ class ShuffleReader:
         final ``record_stats=True`` read (as the bench loop does) or
         sync and handle ``jax.errors.JaxRuntimeError`` yourself.
         """
+        # in-flight accounting wraps the whole read so heartbeat lines
+        # (and shuffle_top) can tell a host mid-read from an idle one
+        self._m._read_started()
+        try:
+            return self._read(record_stats)
+        finally:
+            self._m._read_finished()
+
+    def _read(self, record_stats: bool) -> Tuple[jax.Array, jax.Array]:
         writer = self._m._recover_writer(self._h)
         ex = self._m._exchange
         conf = self._m.conf
@@ -332,7 +342,7 @@ class ShuffleReader:
                 from sparkrdma_tpu.hbm.host_staging import spill_count
 
                 pool = self._m.runtime.pool
-                self._m.journal.emit(ExchangeSpan(
+                span = ExchangeSpan(
                     span_id=span_id,
                     shuffle_id=self._h.shuffle_id,
                     transport=self._m.conf.transport,
@@ -354,9 +364,22 @@ class ShuffleReader:
                     process_index=self._m.runtime.process_index,
                     host_count=self._m.runtime.process_count,
                     # drain restarts the timeline clock, so the next
-                    # span's events are relative to this emit
+                    # span's events are relative to this emit (a
+                    # sampled-away span still drains — and discards)
                     events=self._m.timeline.drain(),
-                ))
+                )
+                # sampling decides whether the full span lands; the
+                # rollup folds the read either way, so window totals
+                # stay exact under any journal_sample
+                weight = self._m.sampler.keep_weight(
+                    span_id, span_latency_ms(span) / 1e3)
+                if self._m.rollup is not None:
+                    self._m.rollup.observe(span, kept=weight > 0)
+                if weight > 0:
+                    span.sample_weight = weight
+                    self._m.journal.emit(span)
+                else:
+                    self._m.metrics.counter("journal.sampled_out").inc()
         del incoming
         return out, totals
 
@@ -505,7 +528,33 @@ class ShuffleManager:
         if isinstance(sink, str) and "{process}" in sink:
             sink = sink.replace("{process}",
                                 str(self.runtime.process_index))
-        self.journal = ExchangeJournal(sink, metrics=self.metrics)
+        self.journal = ExchangeJournal(sink, metrics=self.metrics,
+                                       max_bytes=self.conf.journal_max_bytes)
+        # span sampling: which reads get a full journal line (the rest
+        # still feed metrics + rollups; see obs.journal.SamplingPolicy)
+        self.sampler = self.conf.sampling_policy()
+        # windowed rollups: exact per-shuffle aggregates regardless of
+        # sampling, one {"kind":"rollup"} line per window
+        self.rollup = (RollupAggregator(
+            self.journal, window_s=self.conf.rollup_window_s,
+            process_index=self.runtime.process_index)
+            if self.journal.enabled and self.conf.rollup_window_s > 0
+            else None)
+        # liveness: reads currently executing (heartbeat + shuffle_top)
+        self._reads_in_flight = 0
+        self.heartbeat = None
+        if self.journal.enabled and self.conf.heartbeat_s > 0:
+            pool = self.runtime.pool
+            self.heartbeat = HeartbeatEmitter(
+                self.journal, self.conf.heartbeat_s,
+                identity=self.runtime.process_identity(),
+                probes={
+                    "in_flight": lambda: self._reads_in_flight,
+                    "pool_outstanding": (
+                        lambda: pool.outstanding if pool is not None
+                        else 0),
+                })
+            self.heartbeat.start()
         # per-span event timeline: events accumulate across plan+read and
         # drain into the span's `events` array at emit time
         self.timeline = EventTimeline(enabled=self.journal.enabled)
@@ -529,7 +578,12 @@ class ShuffleManager:
                                          metrics=self.metrics,
                                          stats=self.stats,
                                          timeline=self.timeline,
-                                         watchdog=self.watchdog)
+                                         watchdog=self.watchdog,
+                                         journal=self.journal,
+                                         rollup=self.rollup,
+                                         identity=(
+                                             self.runtime.process_index,
+                                             self.runtime.process_count))
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
         self._registry = MapOutputRegistry(ids, metrics=self.metrics)
@@ -683,9 +737,21 @@ class ShuffleManager:
     def stop(self) -> None:
         if self.stats.enabled and self.stats.records:
             self.stats.print_histogram()
+        if self.heartbeat is not None:
+            self.heartbeat.stop()       # emits one final beat
+        if self.rollup is not None:
+            self.rollup.flush()         # close the open window
         self.journal.close()
         self._writers.clear()
         self.runtime.stop()
+
+    def _read_started(self) -> None:
+        self._reads_in_flight += 1
+        self.metrics.gauge("reads.in_flight").set(self._reads_in_flight)
+
+    def _read_finished(self) -> None:
+        self._reads_in_flight -= 1
+        self.metrics.gauge("reads.in_flight").set(self._reads_in_flight)
 
     # --- helpers ------------------------------------------------------
     def _filtered(self, out: jax.Array, totals: jax.Array,

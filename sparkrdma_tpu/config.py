@@ -115,7 +115,8 @@ class ShuffleConf:
     #: not stable (and requires a power-of-two output capacity — see
     #: geometry_classes). Default OFF: measured on v5e at 16M x 16B records the
     #: kernel's in-VMEM merge network (~40ms/stage) loses to lax.sort's
-    #: own fused stages (~6.6ms/doubling; scripts/profile7.py) — XLA's
+    #: own fused stages (~6.6ms/doubling; scripts/profile_sweep.py
+    #: mergepath) — XLA's
     #: sort is already near the bitonic bandwidth floor on this
     #: hardware. The kernel is kept correct + tested as the scaffold for
     #: later-generation tuning; opt in to measure.
@@ -154,7 +155,7 @@ class ShuffleConf:
     #: bytes — the whole record rides, no gather pass at all.
     #:
     #: Round-5 v5e measurements (three layers, each overturning the
-    #: last — scripts/profile12.py + bench.py A/B hooks):
+    #: last — scripts/profile_sweep.py ab + bench.py A/B hooks):
     #: - standalone same-process, 16M records: packed wins at both
     #:   bench widths (W=25: 620ms vs 625 mono vs 805 ride+gather;
     #:   W=13: 387 vs 439);
@@ -198,6 +199,32 @@ class ShuffleConf:
     #: waits on demand. Size it well above a healthy chunk's wall-clock
     #: — the watchdog observes the wait, it never interrupts it.
     watchdog_timeout_s: float = 0.0
+    #: span sampling policy (sparkrdma_tpu.obs.journal.SamplingPolicy):
+    #: "all" (default — every recorded read writes a full span),
+    #: "1/N" (deterministic 1-in-N by span id; kept spans carry
+    #: sample_weight=N so reports scale counts back up), "slow:<ms>"
+    #: (always keep latency outliers at/above the threshold), or the
+    #: union "1/N+slow:<ms>". Sampled-away reads still feed metrics and
+    #: the windowed rollups, so aggregate totals stay exact — sampling
+    #: thins per-read detail, never the accounting.
+    journal_sample: str = "all"
+    #: windowed-rollup period (sparkrdma_tpu.obs.rollup): every read is
+    #: folded into per-shuffle windows of this many seconds and each
+    #: window lands as one {"kind":"rollup"} journal line — exact
+    #: counts/bytes/latency-histogram regardless of journal_sample.
+    #: 0 disables rollups (spans only, the pre-v3 behavior).
+    rollup_window_s: float = 30.0
+    #: heartbeat period: every this many seconds the manager appends a
+    #: {"kind":"heartbeat"} line (process identity, uptime, in-flight
+    #: reads, pool occupancy, rss) so shuffle_top.py can tell a silent
+    #: host from an idle one. 0 (default) disables.
+    heartbeat_s: float = 0.0
+    #: size-based journal rotation: when the live journal segment
+    #: exceeds this many bytes it is atomically renamed to ``<sink>.1``
+    #: (shifting older segments to .2, .3, …) and a fresh segment
+    #: starts. 0 (default) = never rotate. The report/trace/top CLIs
+    #: and read_entries(include_rotated=True) walk all segments.
+    journal_max_bytes: int = 0
 
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue
@@ -249,6 +276,14 @@ class ShuffleConf:
             raise ValueError("compression_level must be in [0, 9]")
         if self.watchdog_timeout_s < 0:
             raise ValueError("watchdog_timeout_s must be >= 0 (0 disables)")
+        if self.rollup_window_s < 0:
+            raise ValueError("rollup_window_s must be >= 0 (0 disables)")
+        if self.heartbeat_s < 0:
+            raise ValueError("heartbeat_s must be >= 0 (0 disables)")
+        if self.journal_max_bytes < 0:
+            raise ValueError("journal_max_bytes must be >= 0 (0 = no "
+                             "rotation)")
+        self.sampling_policy()  # validate journal_sample eagerly
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property
@@ -263,6 +298,13 @@ class ShuffleConf:
 
     def prealloc_classes(self) -> Dict[int, int]:
         return _parse_prealloc(self.prealloc)
+
+    def sampling_policy(self):
+        """Parsed ``journal_sample`` (obs.journal.SamplingPolicy)."""
+        # local import: config must stay importable before the package
+        # root finishes initializing (obs.journal is stdlib-only)
+        from sparkrdma_tpu.obs.journal import SamplingPolicy
+        return SamplingPolicy.parse(self.journal_sample)
 
     def replace(self, **kw) -> "ShuffleConf":
         return dataclasses.replace(self, **kw)

@@ -192,7 +192,10 @@ class ShuffleExchange:
                  metrics: Optional[MetricsRegistry] = None,
                  stats: Optional[ShuffleReadStats] = None,
                  timeline: Optional[EventTimeline] = None,
-                 watchdog: Optional[StallWatchdog] = None):
+                 watchdog: Optional[StallWatchdog] = None,
+                 journal=None,
+                 rollup=None,
+                 identity: Tuple[int, int] = (0, 1)):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
@@ -221,6 +224,16 @@ class ShuffleExchange:
             self.stats = ShuffleReadStats(
                 enabled=self.conf.collect_shuffle_read_stats,
                 registry=self.metrics)
+        # optional journal + rollup aggregator so DIRECT exchange users
+        # (same population as above) emit sampled spans and exact window
+        # rollups too; shuffle() feeds them. ``identity`` is the
+        # (process_index, host_count) pair stamped into those spans —
+        # the manager passes the real mesh identity, standalone users
+        # default to single-host.
+        self.journal = journal
+        self.rollup = rollup
+        self.sampler = self.conf.sampling_policy()
+        self.identity = identity
         self._exec_cache: Dict[Tuple, Callable] = {}
         self._count_cache: Dict[Tuple, Callable] = {}
         # previous output per (shuffle_id, geometry), recycled as the next
@@ -1030,10 +1043,14 @@ class ShuffleExchange:
         :class:`~sparkrdma_tpu.obs.stats.ExchangeRecord` to ``self.stats``
         (timed to completion via a hard barrier) — this is the stats path
         for exchanges driven WITHOUT a ShuffleManager, e.g. the ring /
-        hierarchical transport benches.
+        hierarchical transport benches. When constructed with a
+        ``journal``, each call additionally emits a (sampled) journal
+        span and feeds the window ``rollup`` — so those same standalone
+        paths show up in ``shuffle_report.py`` / ``shuffle_top.py``.
         """
         plan = self.plan(records, partitioner, num_parts, capacity)
-        if not self.stats.enabled:
+        journal_on = self.journal is not None and self.journal.enabled
+        if not (self.stats.enabled or journal_on):
             out, totals, _ = self.exchange(records, partitioner, plan,
                                            num_parts, shuffle_id=shuffle_id)
             return out, totals, plan
@@ -1043,15 +1060,46 @@ class ShuffleExchange:
             out, totals, _ = self.exchange(records, partitioner, plan,
                                            num_parts, shuffle_id=shuffle_id)
             barrier(out, totals)
-        self.stats.add(ExchangeRecord(
-            shuffle_id=shuffle_id,
-            plan_s=self.last_plan_s,
-            exec_s=t.elapsed,
-            total_records=plan.total_records,
-            record_bytes=records.shape[0] * 4,
-            num_rounds=plan.num_rounds,
-            per_source_records=plan.counts.sum(axis=1),
-        ))
+        if self.stats.enabled:
+            self.stats.add(ExchangeRecord(
+                shuffle_id=shuffle_id,
+                plan_s=self.last_plan_s,
+                exec_s=t.elapsed,
+                total_records=plan.total_records,
+                record_bytes=records.shape[0] * 4,
+                num_rounds=plan.num_rounds,
+                per_source_records=plan.counts.sum(axis=1),
+            ))
+        if journal_on:
+            from sparkrdma_tpu.obs.journal import (ExchangeSpan,
+                                                   next_span_id)
+            span_id = next_span_id()
+            span = ExchangeSpan(
+                span_id=span_id,
+                shuffle_id=shuffle_id,
+                transport=self.conf.transport,
+                rounds=plan.num_rounds,
+                dispatches=self.last_dispatches,
+                records=plan.total_records,
+                record_bytes=records.shape[0] * 4,
+                plan_s=self.last_plan_s,
+                exchange_s=t.elapsed,
+                sort_s=0.0,
+                per_peer_records=[int(c) for c in plan.counts.sum(axis=1)],
+                pool_high_water=(self.pool.outstanding_high_water
+                                 if self.pool is not None else 0),
+                process_index=self.identity[0],
+                host_count=self.identity[1],
+                events=self.timeline.drain(),
+            )
+            weight = self.sampler.keep_weight(span_id, t.elapsed)
+            if self.rollup is not None:
+                self.rollup.observe(span, kept=weight > 0)
+            if weight > 0:
+                span.sample_weight = weight
+                self.journal.emit(span)
+            else:
+                self.metrics.counter("journal.sampled_out").inc()
         return out, totals, plan
 
 

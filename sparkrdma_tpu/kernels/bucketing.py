@@ -99,7 +99,7 @@ def bucket_records(
         # single destination: the batch IS the one run — no reorder, no
         # histogram (the degenerate case a 1-chip mesh hits on its hot
         # path; the monolithic 5-operand sort this skips is ~100ms at
-        # 16M records on TPU, measured scripts/profile3.py)
+        # 16M records on TPU, measured scripts/profile_sweep.py sortform)
         return (records,
                 jnp.full((1,), n, jnp.int32),
                 jnp.zeros((1,), jnp.int32))

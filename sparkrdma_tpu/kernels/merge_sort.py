@@ -9,7 +9,7 @@ is a TPU-native two-phase sort over columnar records ``uint32[W, N]``:
    chunks of ``L0`` records. XLA keeps each chunk VMEM-resident, so this
    costs ~1 HBM read+write plus the in-VMEM network — measured ~5x faster
    per byte than a monolithic ``lax.sort`` at 16M records
-   (scripts/profile4.py: 15.8ms vs 77ms chunked@32K).
+   (scripts/profile_sweep.py fastsort: 15.8ms vs 77ms chunked@32K).
 2. **Merge stages** (Pallas): ``log2(N/L0)`` stages; stage ``s`` merges
    pairs of sorted runs of length ``R`` into runs of ``2R``. Each stage is
    ONE kernel pass over the array: for every output tile of ``T`` records,
@@ -20,7 +20,8 @@ is a TPU-native two-phase sort over columnar records ``uint32[W, N]``:
    concatenation is bitonic), and writes the first ``T`` — a linear merge
    at HBM bandwidth instead of ``lax.sort``'s O(log^2) global passes.
 
-MEASURED STATUS (v5e, 16M x 16B records, scripts/profile7.py): correct
+MEASURED STATUS (v5e, 16M x 16B records, scripts/profile_sweep.py
+mergepath): correct
 compiled and in interpret mode, but slower than monolithic ``lax.sort``
 (~387ms vs ~82ms): each stage's HBM traffic is indeed ~2 scans, but the
 in-VMEM bitonic merge network (reverse 17 + merge 17 passes over the

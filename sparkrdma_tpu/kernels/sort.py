@@ -130,7 +130,7 @@ def packed_lexsort_cols(
     """:func:`lexsort_cols` with u64 OPERAND PACKING — same contract,
     roughly half the operand count at equal bytes.
 
-    Round-5 measurement (scripts/profile11.py, profile12.py, v5e 16M
+    Round-5 measurement (scripts/profile_sweep.py pack + ab, v5e 16M
     records): variadic sort cost turns superlinear in OPERAND COUNT
     past ~13, so carrying 25 words as 13 packed operands (1 u64 key +
     11 u64 + 1 u32 payload) runs ~25% faster than the 25-operand

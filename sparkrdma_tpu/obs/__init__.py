@@ -1,28 +1,43 @@
-"""Unified observability: metrics, journal, timeline, watchdog, stats.
+"""Unified observability: metrics, journal, rollups, timeline, watchdog.
 
 See :mod:`sparkrdma_tpu.obs.metrics` for the registry contract,
-:mod:`sparkrdma_tpu.obs.journal` for the JSON-lines exchange journal,
+:mod:`sparkrdma_tpu.obs.journal` for the JSON-lines exchange journal
+(span sampling, rotation), :mod:`sparkrdma_tpu.obs.rollup` for windowed
+rollups + heartbeats,
 :mod:`sparkrdma_tpu.obs.timeline` for the bounded in-span event recorder,
 :mod:`sparkrdma_tpu.obs.watchdog` for the stall watchdog,
-``scripts/shuffle_report.py`` for the offline aggregator and
-``scripts/shuffle_trace.py`` for the Chrome-trace (Perfetto) exporter.
+``scripts/shuffle_report.py`` for the offline aggregator,
+``scripts/shuffle_trace.py`` for the Chrome-trace (Perfetto) exporter and
+``scripts/shuffle_top.py`` for the live journal monitor.
 """
 
 from sparkrdma_tpu.obs.journal import (
     SCHEMA_VERSION,
     ExchangeJournal,
     ExchangeSpan,
+    SamplingPolicy,
+    iter_entries,
     next_span_id,
     read_entries,
     read_journal,
+    rotated_paths,
 )
 from sparkrdma_tpu.obs.metrics import (
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
+    bucket_quantile,
     global_registry,
     set_global_registry,
+)
+from sparkrdma_tpu.obs.rollup import (
+    HEARTBEAT_FIELDS,
+    LATENCY_BOUNDS_MS,
+    ROLLUP_FIELDS,
+    HeartbeatEmitter,
+    RollupAggregator,
+    span_latency_ms,
 )
 from sparkrdma_tpu.obs.stats import ExchangeRecord, ShuffleReadStats
 from sparkrdma_tpu.obs.timeline import (
@@ -38,10 +53,13 @@ from sparkrdma_tpu.obs.watchdog import (
 )
 
 __all__ = [
-    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "bucket_quantile",
     "global_registry", "set_global_registry",
-    "ExchangeJournal", "ExchangeSpan", "read_journal", "read_entries",
+    "ExchangeJournal", "ExchangeSpan", "SamplingPolicy",
+    "read_journal", "read_entries", "iter_entries", "rotated_paths",
     "next_span_id", "SCHEMA_VERSION",
+    "RollupAggregator", "HeartbeatEmitter", "span_latency_ms",
+    "ROLLUP_FIELDS", "HEARTBEAT_FIELDS", "LATENCY_BOUNDS_MS",
     "EventTimeline", "NULL_TIMELINE", "set_active", "record_active",
     "StallWatchdog", "dump_armed", "install_state_dump",
     "ExchangeRecord", "ShuffleReadStats",

@@ -27,22 +27,46 @@ to answer "which exchange round, which peer, which pool is slow" offline:
   drained from :class:`~sparkrdma_tpu.obs.timeline.EventTimeline`
   (per-chunk dispatch/queue-block/fold, pool acquires, spills, retries,
   stalls), convertible to a Perfetto-viewable Chrome trace with
-  ``scripts/shuffle_trace.py``.
+  ``scripts/shuffle_trace.py``;
+- **sampling** (schema v3): ``sample_weight`` — how many reads this span
+  statistically stands for. Under ``ShuffleConf.journal_sample`` (e.g.
+  ``1/8+slow:250``) only a deterministic 1-in-N subset of spans plus
+  every latency outlier is written in full; a span kept by the 1/N rule
+  carries ``sample_weight=N`` so readers can scale counts back up, a
+  slow-outlier-only span carries weight 1 (it represents just itself).
+  Dropped spans still feed metrics and the windowed rollups, so
+  aggregate totals stay exact (Dapper-style sampled tracing on top of
+  Monotasks-style always-on accounting).
 
 Besides spans, a journal may carry **auxiliary lines** tagged with a
-``"kind"`` field — today ``{"kind": "stall", ...}`` records written by
-:mod:`sparkrdma_tpu.obs.watchdog` while a read is still blocked (the
-read's own span only ever lands if the wait completes).
+``"kind"`` field:
+
+- ``{"kind": "stall", ...}`` — flight-recorder records written by
+  :mod:`sparkrdma_tpu.obs.watchdog` while a read is still blocked (the
+  read's own span only ever lands if the wait completes);
+- ``{"kind": "rollup", ...}`` — per-shuffle windowed aggregates from
+  :mod:`sparkrdma_tpu.obs.rollup` (exact counts even under sampling);
+- ``{"kind": "heartbeat", ...}`` — periodic liveness lines (process
+  identity, uptime, in-flight reads, pool occupancy, rss) so a silent
+  host is distinguishable from an idle one.
+
 :func:`read_journal` returns spans only; :func:`read_entries` returns
 everything.
 
+**Rotation**: long-running processes cap the live segment with
+``ShuffleConf.journal_max_bytes``; when a write pushes the file past the
+cap the journal atomically renames ``j`` → ``j.1`` (shifting ``j.1`` →
+``j.2``, …) and starts a fresh segment. ``rotated_paths`` lists all
+segments oldest-first; the readers and every CLI accept them.
+
 Schema compatibility contract (pinned by tests): readers drop unknown
-keys and default missing ones, so a v1 line parses under the v2 reader
-(``events`` empty, single-host identity) and a v2 line parses under a
-v1-era reader (the timeline is simply invisible to it).
+keys and default missing ones, so a v1/v2 line parses under the v3
+reader (``events`` empty, single-host identity, ``sample_weight`` 1)
+and a v3 line parses under earlier readers (the new fields are simply
+invisible to them).
 
 Aggregate with ``scripts/shuffle_report.py``; export traces with
-``scripts/shuffle_trace.py``.
+``scripts/shuffle_trace.py``; watch live with ``scripts/shuffle_top.py``.
 """
 
 from __future__ import annotations
@@ -51,14 +75,17 @@ import dataclasses
 import io
 import json
 import logging
+import os
 import threading
 import time
-from typing import IO, Dict, List, Optional, Union
+from typing import IO, Dict, Iterator, List, Optional, Union
 
 log = logging.getLogger("sparkrdma_tpu.journal")
 
-#: v2: + ``events`` timeline, + ``process_index``/``host_count`` identity
-SCHEMA_VERSION = 2
+#: v2: + ``events`` timeline, + ``process_index``/``host_count`` identity.
+#: v3: + ``sample_weight`` (span sampling), + auxiliary ``rollup`` and
+#: ``heartbeat`` line kinds (see obs/rollup.py).
+SCHEMA_VERSION = 3
 
 
 @dataclasses.dataclass
@@ -88,6 +115,8 @@ class ExchangeSpan:
     host_count: int = 1
     # --- in-span event timeline (schema v2); see obs/timeline.py ---
     events: List[Dict] = dataclasses.field(default_factory=list)
+    # --- sampling (schema v3): reads this span stands for (>=1) ---
+    sample_weight: int = 1
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 
@@ -103,7 +132,7 @@ class ExchangeSpan:
     @classmethod
     def from_dict(cls, d: dict) -> "ExchangeSpan":
         # forward/backward compat: unknown keys dropped, missing keys
-        # defaulted — the v1 <-> v2 contract (see module docstring)
+        # defaulted — the cross-version contract (see module docstring)
         fields = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in fields})
 
@@ -121,6 +150,90 @@ def next_span_id() -> int:
         return _span_id_next
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer — a fixed, platform-independent integer hash.
+
+    The sampling decision must be a pure function of the span id (same
+    id → same keep/drop on every host, every run, every Python), so it
+    cannot use ``hash()`` (salted per process) or anything seeded.
+    """
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return (x ^ (x >> 31)) & _MASK64
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingPolicy:
+    """Per-read keep/drop policy for full-span emission.
+
+    Parsed from ``ShuffleConf.journal_sample``:
+
+    - ``all`` — keep every span (default; weight 1);
+    - ``1/N`` — keep a deterministic 1-in-N subset, chosen by a fixed
+      hash of the span id (kept spans carry ``sample_weight=N``);
+    - ``slow:<ms>`` — always keep spans whose exchange+sort wall-clock
+      is at least ``<ms>`` milliseconds (weight 1 — an outlier only
+      represents itself);
+    - ``1/N+slow:<ms>`` — union of both rules.
+
+    :meth:`keep_weight` returns 0 to drop, else the span's
+    ``sample_weight``. Dropped spans must still be folded into metrics
+    and rollups by the caller — sampling thins the *detail*, never the
+    aggregates.
+    """
+
+    rate: int = 1          # keep 1 in ``rate`` spans (1 = all)
+    slow_ms: float = 0.0   # always keep spans at least this slow (0 = off)
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "SamplingPolicy":
+        def bad(why: str) -> ValueError:
+            return ValueError(
+                f"bad journal_sample spec {spec!r} ({why}): expected 'all', "
+                f"'1/N', 'slow:<ms>', or '1/N+slow:<ms>'")
+
+        rate, slow = 1, 0.0
+        for part in (spec or "all").strip().split("+"):
+            part = part.strip()
+            if part == "all":
+                pass
+            elif part.startswith("1/"):
+                try:
+                    rate = int(part[2:])
+                except ValueError:
+                    raise bad(f"unparsable rate {part!r}") from None
+                if rate < 1:
+                    raise bad("N must be >= 1")
+            elif part.startswith("slow:"):
+                try:
+                    slow = float(part[5:])
+                except ValueError:
+                    raise bad(f"unparsable threshold {part!r}") from None
+                if slow < 0 or slow != slow:  # negative or NaN
+                    raise bad("threshold must be >= 0 ms")
+            else:
+                raise bad(f"unknown term {part!r}")
+        return cls(rate=rate, slow_ms=slow)
+
+    @property
+    def samples_all(self) -> bool:
+        return self.rate <= 1
+
+    def keep_weight(self, span_id: int, elapsed_s: float) -> int:
+        """0 = drop the span; N > 0 = keep it with ``sample_weight=N``."""
+        if self.rate <= 1:
+            return 1
+        if _mix64(span_id) % self.rate == 0:
+            return self.rate
+        if self.slow_ms > 0.0 and elapsed_s * 1e3 >= self.slow_ms:
+            return 1
+        return 0
+
+
 class ExchangeJournal:
     """Append-only JSON-lines sink for :class:`ExchangeSpan` records.
 
@@ -130,6 +243,12 @@ class ExchangeJournal:
     in-memory capture), or None/"" (disabled: :meth:`emit` is a no-op
     and no I/O ever happens).
 
+    ``max_bytes`` > 0 enables size-based rotation for path sinks: when a
+    write pushes the live segment past the cap, existing segments shift
+    (``j.1`` → ``j.2``, …), the live file is atomically renamed to
+    ``j.1`` and a fresh segment starts. ``rotations`` counts how often
+    (mirrored to the ``journal.rotations`` metric).
+
     **A journal failure must never kill a shuffle**: the first
     ``OSError`` on open/write disables the sink, logs once, and bumps
     ``journal.write_errors`` in ``metrics`` (when provided); the read
@@ -138,13 +257,17 @@ class ExchangeJournal:
     """
 
     def __init__(self, sink: Union[str, IO[str], None] = None,
-                 metrics=None):
+                 metrics=None, max_bytes: int = 0):
         self._path: Optional[str] = None
         self._fh: Optional[IO[str]] = None
         self._own_fh = False
         self._lock = threading.Lock()
         self._metrics = metrics
+        self.max_bytes = int(max_bytes)
+        self._seg_bytes = 0                 # bytes in the live segment
         self.emitted = 0
+        #: completed size-based rotations of the live segment
+        self.rotations = 0
         #: write failures observed (after the first, the sink is dead)
         self.write_errors = 0
         if sink is None or sink == "":
@@ -168,8 +291,9 @@ class ExchangeJournal:
     def emit_raw(self, entry: dict) -> None:
         """Append an auxiliary (non-span) line — MUST carry ``"kind"``.
 
-        The watchdog's stall records use this; :func:`read_journal`
-        skips such lines, :func:`read_entries` surfaces them.
+        Stall, rollup and heartbeat records use this;
+        :func:`read_journal` skips such lines, :func:`read_entries`
+        surfaces them.
         """
         if not self.enabled:
             return
@@ -184,9 +308,18 @@ class ExchangeJournal:
                 if self._fh is None:
                     self._fh = open(self._path, "a", encoding="utf-8")
                     self._own_fh = True
+                    try:
+                        self._seg_bytes = os.fstat(self._fh.fileno()).st_size
+                    except (OSError, AttributeError, ValueError):
+                        self._seg_bytes = 0
                 self._fh.write(line + "\n")
                 self._fh.flush()
                 self.emitted += 1
+                self._seg_bytes += len(line) + 1
+                if (self.max_bytes > 0 and self._own_fh
+                        and self._path is not None
+                        and self._seg_bytes >= self.max_bytes):
+                    self._rotate_locked()
             except OSError as e:
                 # disable on first failure: one loud log line, then the
                 # journal goes quiet instead of failing every read
@@ -203,6 +336,28 @@ class ExchangeJournal:
                 self._own_fh = False
                 if self._metrics is not None:
                     self._metrics.counter("journal.write_errors").inc()
+
+    def _rotate_locked(self) -> None:
+        """Shift ``j.N`` → ``j.N+1`` and rename the live file to ``j.1``.
+
+        Caller holds ``_lock``. Renames are atomic (``os.replace``), so
+        a concurrent tailer sees either the old or the new name — never
+        a torn file. A failed rotation follows the normal disable path
+        via the caller's ``except OSError``.
+        """
+        self._fh.close()
+        self._fh = None
+        self._own_fh = False
+        n = 1
+        while os.path.exists(f"{self._path}.{n}"):
+            n += 1
+        for i in range(n, 1, -1):
+            os.replace(f"{self._path}.{i - 1}", f"{self._path}.{i}")
+        os.replace(self._path, f"{self._path}.1")
+        self._seg_bytes = 0
+        self.rotations += 1
+        if self._metrics is not None:
+            self._metrics.counter("journal.rotations").inc()
 
     def close(self) -> None:
         """Close owned sinks; flush (but never close) borrowed ones.
@@ -224,23 +379,70 @@ class ExchangeJournal:
                 pass
 
 
-def read_entries(path: str) -> List[dict]:
-    """Parse every journal line (spans AND auxiliary records) as dicts."""
-    entries = []
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                entries.append(json.loads(line))
-    return entries
+def rotated_paths(path: str) -> List[str]:
+    """Every existing segment of a (possibly rotated) journal,
+    oldest-first: ``[j.K, ..., j.2, j.1, j]``."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
 
 
-def read_journal(path: str) -> List[ExchangeSpan]:
+def iter_entries(path: str, errors: Optional[List[str]] = None,
+                 include_rotated: bool = False) -> Iterator[dict]:
+    """Stream journal lines as dicts, one at a time.
+
+    Corrupt lines — e.g. a truncated tail left by a killed process —
+    are skipped (and described in ``errors`` when a list is passed)
+    instead of raising: one bad byte must not make a gigabyte of
+    telemetry unreadable. ``include_rotated`` walks rotated segments
+    (``path.N``) oldest-first before the live file.
+    """
+    paths = rotated_paths(path) if include_rotated else [path]
+    for p in paths:
+        with open(p, encoding="utf-8", errors="replace") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError as e:
+                    if errors is not None:
+                        errors.append(f"{p}:{lineno}: {e}")
+                    continue
+                if isinstance(obj, dict):
+                    yield obj
+                elif errors is not None:
+                    errors.append(f"{p}:{lineno}: not a JSON object")
+
+
+def read_entries(path: str, errors: Optional[List[str]] = None,
+                 include_rotated: bool = False) -> List[dict]:
+    """Parse every journal line (spans AND auxiliary records) as dicts.
+
+    Built on :func:`iter_entries` — corrupt lines are skipped, not
+    fatal; pass ``errors=[]`` to collect their descriptions.
+    """
+    return list(iter_entries(path, errors=errors,
+                             include_rotated=include_rotated))
+
+
+def read_journal(path: str, include_rotated: bool = False
+                 ) -> List[ExchangeSpan]:
     """Parse a journal file back into spans (blank lines skipped;
-    auxiliary ``kind``-tagged lines — stall records — skipped too)."""
-    return [ExchangeSpan.from_dict(d) for d in read_entries(path)
+    auxiliary ``kind``-tagged lines — stall/rollup/heartbeat records —
+    skipped too)."""
+    return [ExchangeSpan.from_dict(d)
+            for d in iter_entries(path, include_rotated=include_rotated)
             if d.get("kind") in (None, "span")]
 
 
-__all__ = ["ExchangeSpan", "ExchangeJournal", "read_journal",
-           "read_entries", "next_span_id", "SCHEMA_VERSION"]
+__all__ = ["ExchangeSpan", "ExchangeJournal", "SamplingPolicy",
+           "read_journal", "read_entries", "iter_entries", "rotated_paths",
+           "next_span_id", "SCHEMA_VERSION"]

@@ -150,6 +150,58 @@ class Histogram:
                 "buckets": list(self._buckets),
             }
 
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile from the buckets (see
+        :func:`bucket_quantile`); 0.0 when empty."""
+        with self._lock:
+            buckets = list(self._buckets)
+            lo, hi = self._min, self._max
+        return bucket_quantile(self.bounds, buckets, q, lo=lo, hi=hi)
+
+
+def bucket_quantile(bounds: Sequence[Number], buckets: Sequence[int],
+                    q: float, lo: Optional[Number] = None,
+                    hi: Optional[Number] = None) -> float:
+    """Estimate the ``q``-quantile of a fixed-bucket histogram.
+
+    ``bounds`` are inclusive upper edges; ``buckets`` has one extra
+    overflow cell. Linear interpolation inside the bucket holding the
+    rank — the standard Prometheus-style estimate, so p99 from a rollup
+    line is comparable across hosts regardless of sample counts. ``lo``
+    / ``hi`` (observed min/max, when known) tighten the first and the
+    overflow bucket, whose edges are otherwise 0 and the last bound.
+    """
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    q = min(max(q, 0.0), 1.0)
+    rank = q * total
+    seen = 0.0
+    est = float(hi if hi is not None else bounds[-1])
+    for i, n in enumerate(buckets):
+        if n <= 0:
+            continue
+        if seen + n >= rank:
+            lower = bounds[i - 1] if i > 0 else (
+                lo if lo is not None else 0.0)
+            if i < len(bounds):
+                upper = bounds[i]
+            else:
+                upper = hi if hi is not None else bounds[-1]
+            if upper < lower:
+                upper = lower
+            frac = (rank - seen) / n
+            est = lower + (upper - lower) * frac
+            break
+        seen += n
+    # the observed extrema are exact — never let bucket interpolation
+    # place a quantile outside them
+    if hi is not None:
+        est = min(est, hi)
+    if lo is not None:
+        est = max(est, lo)
+    return est
+
 
 class _NullCounter(Counter):
     __slots__ = ()
@@ -291,4 +343,4 @@ def set_global_registry(reg: MetricsRegistry) -> MetricsRegistry:
 
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "global_registry", "set_global_registry"]
+           "bucket_quantile", "global_registry", "set_global_registry"]

@@ -1,0 +1,330 @@
+"""Windowed rollups + heartbeats — the always-on half of the telemetry.
+
+Span sampling (:class:`~sparkrdma_tpu.obs.journal.SamplingPolicy`) keeps
+the journal bounded by throwing away *detail*; this module is what keeps
+the *aggregates* exact while it does so, in the spirit of Monotasks'
+per-resource accounting riding under Dapper-style sampled traces:
+
+- :class:`RollupAggregator` folds **every** read — written in full or
+  sampled away — into per-shuffle windows (count, bytes, spills,
+  retries, streaming/fused split, a fixed-bucket latency histogram for
+  p50/p95/p99) and emits one ``{"kind": "rollup"}`` journal line per
+  shuffle per window. A million reads become hundreds of lines with no
+  fidelity loss on totals; ``shuffle_report.py`` prefers these exact
+  counts over sampling-corrected span estimates whenever present.
+- :class:`HeartbeatEmitter` appends a periodic ``{"kind": "heartbeat"}``
+  line (process identity, uptime, in-flight reads, pool occupancy, rss
+  when the platform exposes it) so a silent host is distinguishable
+  from an idle one — the signal ``scripts/shuffle_top.py`` uses to flag
+  stale hosts live.
+
+Both emitters write through :meth:`ExchangeJournal.emit_raw` and follow
+its fail-safe contract: telemetry must never take down a shuffle, so
+:meth:`HeartbeatEmitter.beat` swallows (and counts) its own failures.
+
+``ROLLUP_FIELDS`` / ``HEARTBEAT_FIELDS`` are the authoritative key sets
+of the two line kinds; ``scripts/check_markers.py`` lints every consumer
+(``shuffle_top.py``, ``shuffle_report.py``, ``shuffle_trace.py``)
+against them, and the emitters assert they produce exactly those keys —
+the same schema-sync contract spans already have.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from sparkrdma_tpu.obs.journal import SCHEMA_VERSION, ExchangeSpan
+from sparkrdma_tpu.obs.metrics import bucket_quantile
+
+log = logging.getLogger("sparkrdma_tpu.rollup")
+
+#: upper bucket edges (ms) for the per-window read-latency histogram —
+#: fixed so rollup lines from different hosts/windows merge bucket-wise
+LATENCY_BOUNDS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+#: every key a ``{"kind": "rollup"}`` line carries (lint-pinned)
+ROLLUP_FIELDS = frozenset({
+    "kind", "schema", "ts", "process_index", "shuffle_id",
+    "window_start", "window_s",
+    "reads", "sampled_reads", "records", "bytes", "rounds", "dispatches",
+    "retries", "spills", "streaming_reads", "fused_reads",
+    "lat_bounds_ms", "lat_buckets", "lat_sum_ms", "lat_max_ms",
+    "p50_ms", "p95_ms", "p99_ms",
+})
+
+#: every key a ``{"kind": "heartbeat"}`` line carries (lint-pinned)
+HEARTBEAT_FIELDS = frozenset({
+    "kind", "schema", "ts", "seq", "process_index", "host_count", "host",
+    "pid", "uptime_s", "in_flight", "pool_outstanding", "spans_emitted",
+    "rotations", "rss_mb",
+})
+
+
+def span_latency_ms(span: ExchangeSpan) -> float:
+    """The latency a read 'costs' its caller: exchange + sort wall-clock
+    (plan time is amortized across reads by the plan cache). The same
+    number the ``slow:<ms>`` sampling rule tests, so a kept outlier and
+    its rollup bucket always agree."""
+    return (span.exchange_s + span.sort_s) * 1e3
+
+
+class _Cell:
+    """Accumulator for one (window, shuffle) pair."""
+
+    __slots__ = ("reads", "sampled_reads", "records", "bytes", "rounds",
+                 "dispatches", "retries", "spills", "streaming_reads",
+                 "fused_reads", "lat_buckets", "lat_sum_ms", "lat_max_ms")
+
+    def __init__(self):
+        self.reads = 0
+        self.sampled_reads = 0
+        self.records = 0
+        self.bytes = 0
+        self.rounds = 0
+        self.dispatches = 0
+        self.retries = 0
+        self.spills = 0
+        self.streaming_reads = 0
+        self.fused_reads = 0
+        self.lat_buckets = [0] * (len(LATENCY_BOUNDS_MS) + 1)
+        self.lat_sum_ms = 0.0
+        self.lat_max_ms = 0.0
+
+
+class RollupAggregator:
+    """Folds every span into per-shuffle windows; emits rollup lines.
+
+    ``observe`` is called for each completed read *before* the sampling
+    decision thins the journal — ``kept=False`` marks a span whose full
+    line was dropped, which only affects the ``sampled_reads`` column
+    (how many full spans the journal actually holds for cross-checking).
+    Windows are wall-clock aligned (``floor(now / window_s)``); a window
+    is emitted lazily when the first observation past its end arrives,
+    and :meth:`flush` closes whatever is open (manager shutdown, bench
+    exit). The aggregator itself is a few hundred bytes per active
+    shuffle — bounded regardless of read volume.
+    """
+
+    def __init__(self, journal, window_s: float = 30.0,
+                 process_index: int = 0,
+                 clock: Callable[[], float] = time.time):
+        self._journal = journal
+        self.window_s = float(window_s)
+        self.process_index = process_index
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._window_start: Optional[float] = None
+        self._cells: Dict[int, _Cell] = {}
+        self._last_spill = 0          # spill_count is process-cumulative
+        #: rollup lines emitted over this aggregator's lifetime
+        self.emitted = 0
+
+    def observe(self, span: ExchangeSpan, kept: bool = True,
+                now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        lat_ms = span_latency_ms(span)
+        b = 0
+        while (b < len(LATENCY_BOUNDS_MS)
+               and lat_ms > LATENCY_BOUNDS_MS[b]):
+            b += 1
+        with self._lock:
+            self._roll_locked(now)
+            cell = self._cells.get(span.shuffle_id)
+            if cell is None:
+                cell = self._cells[span.shuffle_id] = _Cell()
+            cell.reads += 1
+            if kept:
+                cell.sampled_reads += 1
+            cell.records += span.records
+            cell.bytes += span.total_bytes
+            cell.rounds += span.rounds
+            cell.dispatches += span.dispatches
+            cell.retries += span.retry_count
+            spill_delta = span.spill_count - self._last_spill
+            if spill_delta > 0:
+                cell.spills += spill_delta
+                self._last_spill = span.spill_count
+            if span.dispatches > 1:
+                cell.streaming_reads += 1
+            else:
+                cell.fused_reads += 1
+            cell.lat_buckets[b] += 1
+            cell.lat_sum_ms += lat_ms
+            if lat_ms > cell.lat_max_ms:
+                cell.lat_max_ms = lat_ms
+
+    def flush(self, now: Optional[float] = None) -> None:
+        """Emit every open cell (shutdown / test hook)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._emit_locked(now)
+
+    def _roll_locked(self, now: float) -> None:
+        start = (now // self.window_s) * self.window_s \
+            if self.window_s > 0 else now
+        if self._window_start is None:
+            self._window_start = start
+        elif start > self._window_start:
+            self._emit_locked(now)
+            self._window_start = start
+
+    def _emit_locked(self, now: float) -> None:
+        for sid in sorted(self._cells):
+            c = self._cells[sid]
+            d = {
+                "kind": "rollup",
+                "schema": SCHEMA_VERSION,
+                "ts": now,
+                "process_index": self.process_index,
+                "shuffle_id": sid,
+                "window_start": self._window_start,
+                "window_s": self.window_s,
+                "reads": c.reads,
+                "sampled_reads": c.sampled_reads,
+                "records": c.records,
+                "bytes": c.bytes,
+                "rounds": c.rounds,
+                "dispatches": c.dispatches,
+                "retries": c.retries,
+                "spills": c.spills,
+                "streaming_reads": c.streaming_reads,
+                "fused_reads": c.fused_reads,
+                "lat_bounds_ms": list(LATENCY_BOUNDS_MS),
+                "lat_buckets": list(c.lat_buckets),
+                "lat_sum_ms": round(c.lat_sum_ms, 3),
+                "lat_max_ms": round(c.lat_max_ms, 3),
+                "p50_ms": round(bucket_quantile(
+                    LATENCY_BOUNDS_MS, c.lat_buckets, 0.50,
+                    hi=c.lat_max_ms), 3),
+                "p95_ms": round(bucket_quantile(
+                    LATENCY_BOUNDS_MS, c.lat_buckets, 0.95,
+                    hi=c.lat_max_ms), 3),
+                "p99_ms": round(bucket_quantile(
+                    LATENCY_BOUNDS_MS, c.lat_buckets, 0.99,
+                    hi=c.lat_max_ms), 3),
+            }
+            assert set(d) == ROLLUP_FIELDS, sorted(
+                set(d) ^ ROLLUP_FIELDS)
+            self._journal.emit_raw(d)
+            self.emitted += 1
+        self._cells.clear()
+
+
+def rss_mb() -> Optional[float]:
+    """Resident set size in MiB, or None where unavailable.
+
+    Prefers ``/proc/self/status`` (current RSS); falls back to
+    ``resource.getrusage`` peak RSS (close enough for a liveness line).
+    No psutil — stdlib only.
+    """
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="replace") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return round(peak_kb / 1024.0, 1)
+    except Exception:
+        return None
+
+
+class HeartbeatEmitter:
+    """Periodic liveness lines from a daemon thread.
+
+    ``identity`` is the stable process identity (see
+    :meth:`MeshRuntime.process_identity`); ``probes`` maps the dynamic
+    fields (``in_flight``, ``pool_outstanding``) to zero-arg callables
+    evaluated at each beat — a probe that raises contributes -1 rather
+    than killing the heartbeat. :meth:`beat` is also callable directly
+    (tests, final beat at shutdown) and never raises.
+    """
+
+    def __init__(self, journal, interval_s: float,
+                 identity: Optional[Dict] = None,
+                 probes: Optional[Dict[str, Callable[[], int]]] = None,
+                 clock: Callable[[], float] = time.time):
+        self._journal = journal
+        self.interval_s = float(interval_s)
+        self._identity = dict(identity or {})
+        self._probes = dict(probes or {})
+        self._clock = clock
+        self._started_at = clock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.seq = 0
+        self.beat_errors = 0
+
+    def start(self) -> None:
+        if self._thread is not None or self.interval_s <= 0:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="sparkrdma-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def _probe(self, name: str) -> int:
+        fn = self._probes.get(name)
+        if fn is None:
+            return 0
+        try:
+            return int(fn())
+        except Exception:
+            return -1
+
+    def beat(self, now: Optional[float] = None) -> None:
+        try:
+            now = self._clock() if now is None else now
+            self.seq += 1
+            d = {
+                "kind": "heartbeat",
+                "schema": SCHEMA_VERSION,
+                "ts": now,
+                "seq": self.seq,
+                "process_index": self._identity.get("process_index", 0),
+                "host_count": self._identity.get("host_count", 1),
+                "host": self._identity.get(
+                    "host", socket.gethostname()),
+                "pid": self._identity.get("pid", os.getpid()),
+                "uptime_s": round(now - self._started_at, 3),
+                "in_flight": self._probe("in_flight"),
+                "pool_outstanding": self._probe("pool_outstanding"),
+                "spans_emitted": getattr(self._journal, "emitted", 0),
+                "rotations": getattr(self._journal, "rotations", 0),
+                "rss_mb": rss_mb(),
+            }
+            assert set(d) == HEARTBEAT_FIELDS, sorted(
+                set(d) ^ HEARTBEAT_FIELDS)
+            self._journal.emit_raw(d)
+        except Exception:
+            # liveness reporting must never take down the process it
+            # reports on; the error count is itself the diagnostic
+            self.beat_errors += 1
+            if self.beat_errors == 1:
+                log.exception("heartbeat emission failed")
+
+    def stop(self, final_beat: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, self.interval_s))
+            self._thread = None
+        if final_beat:
+            self.beat()
+
+
+__all__ = ["RollupAggregator", "HeartbeatEmitter", "LATENCY_BOUNDS_MS",
+           "ROLLUP_FIELDS", "HEARTBEAT_FIELDS", "span_latency_ms",
+           "rss_mb"]

@@ -22,6 +22,8 @@ retries), ``MeshRuntime`` just validates that the peer is a mesh coordinate.
 from __future__ import annotations
 
 import dataclasses
+import os
+import socket
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -115,6 +117,18 @@ class MeshRuntime:
     def process_count(self) -> int:
         """Number of host processes in the job (1 single-host)."""
         return int(jax.process_count())
+
+    def process_identity(self) -> dict:
+        """Stable identity of this host process, as stamped into every
+        ``{"kind": "heartbeat"}`` journal line (obs.rollup): the
+        multi-host rank pair plus the (host, pid) a reference
+        ``RdmaShuffleManagerId`` would carry. JSON-ready."""
+        return {
+            "process_index": self.process_index,
+            "host_count": self.process_count,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+        }
 
     def manager_id(self, device_index: int) -> ManagerId:
         d = self.devices[device_index]

@@ -1,0 +1,518 @@
+"""Production-scale telemetry: sampling, rollups, rotation, heartbeats.
+
+What this pins (PR 3 acceptance):
+
+- ``SamplingPolicy`` parsing (``all`` / ``1/N`` / ``slow:<ms>`` /
+  composed) rejects malformed specs, and ``keep_weight`` is a pure,
+  platform-independent function of the span id — the property that lets
+  every host make the same keep/drop decision without coordination;
+- ``RollupAggregator`` window totals are EXACT regardless of sampling
+  (every observed read counted, kept or dropped) and match raw span
+  sums under ``journal_sample="all"``;
+- size-based rotation never loses or duplicates a span across segment
+  boundaries, and ``read_entries(include_rotated=True)`` walks segments
+  oldest-first;
+- heartbeat lines carry exactly ``HEARTBEAT_FIELDS``, survive failing
+  probes, and drive ``shuffle_top``'s stale-host flag;
+- the v2 <-> v3 schema contract: a v2 line (no ``sample_weight``)
+  parses under the v3 reader with weight 1; a v3 line is readable by a
+  v2-style drop-unknown-keys reader;
+- the manager E2E: with ``journal_sample="1/4"`` only the
+  deterministically-chosen subset of spans lands in full (weight 4),
+  the drop count shows up in ``journal.sampled_out``, rollup totals
+  equal the unsampled run's, ``shuffle_report.py`` flags the journal as
+  sampled, and ``shuffle_top.py --once`` renders a rotated journal.
+"""
+
+import importlib.util
+import io
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+from sparkrdma_tpu.obs.journal import (SCHEMA_VERSION, ExchangeJournal,
+                                       ExchangeSpan, SamplingPolicy, _mix64,
+                                       next_span_id, read_entries,
+                                       read_journal, rotated_paths)
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.rollup import (HEARTBEAT_FIELDS, ROLLUP_FIELDS,
+                                      HeartbeatEmitter, RollupAggregator,
+                                      span_latency_ms)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_cli(name):
+    """Import a stdlib-only CLI in-process (keeps these tests in the
+    fast tier — no worker subprocesses)."""
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+shuffle_top = _load_cli("shuffle_top")
+shuffle_report = _load_cli("shuffle_report")
+
+
+def make_span(span_id=1, shuffle_id=0, **kw):
+    base = dict(span_id=span_id, shuffle_id=shuffle_id, transport="fused",
+                rounds=1, dispatches=1, records=40, record_bytes=16,
+                plan_s=0.01, exchange_s=0.05, sort_s=0.0,
+                per_peer_records=[10, 10, 10, 10])
+    base.update(kw)
+    return ExchangeSpan(**base)
+
+
+def _kinds(span=(), stall=(), rollup=(), heartbeat=()):
+    """A shuffle_top ``collect()``-shaped bucket dict from literals."""
+    return {"span": list(span), "stall": list(stall),
+            "rollup": list(rollup), "heartbeat": list(heartbeat)}
+
+
+class TestSamplingPolicy:
+    def test_parse_forms(self):
+        assert SamplingPolicy.parse(None) == SamplingPolicy(1, 0.0)
+        assert SamplingPolicy.parse("") == SamplingPolicy(1, 0.0)
+        assert SamplingPolicy.parse("all") == SamplingPolicy(1, 0.0)
+        assert SamplingPolicy.parse("1/8") == SamplingPolicy(8, 0.0)
+        assert SamplingPolicy.parse("slow:250") == SamplingPolicy(1, 250.0)
+        assert SamplingPolicy.parse("1/8+slow:250") == \
+            SamplingPolicy(8, 250.0)
+        # whitespace around terms is cosmetic
+        assert SamplingPolicy.parse(" 1/8 + slow:250 ") == \
+            SamplingPolicy(8, 250.0)
+
+    @pytest.mark.parametrize("bad", ["1/0", "1/-2", "1/x", "x", "2/3",
+                                     "1/8+", "slow:", "slow:abc",
+                                     "slow:-3", "1/8+fast:1"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError, match="journal_sample"):
+            SamplingPolicy.parse(bad)
+
+    def test_conf_validates_eagerly(self):
+        with pytest.raises(ValueError, match="journal_sample"):
+            ShuffleConf(slot_records=64, journal_sample="1/0")
+
+    def test_keep_weight_is_pure_function_of_span_id(self):
+        """Same id -> same decision on a fresh policy instance (no
+        process salt, no hidden state) — recomputable anywhere."""
+        a = SamplingPolicy.parse("1/8")
+        b = SamplingPolicy.parse("1/8")
+        decisions = [a.keep_weight(i, 0.0) for i in range(1, 2001)]
+        assert decisions == [b.keep_weight(i, 0.0) for i in range(1, 2001)]
+        assert set(decisions) == {0, 8}, "1/N keeps carry weight N"
+
+    def test_rate_selects_about_one_in_n(self):
+        pol = SamplingPolicy.parse("1/8")
+        kept = sum(1 for i in range(1, 8001)
+                   if pol.keep_weight(i, 0.0) > 0)
+        # splitmix64 is uniform: expect ~1000 of 8000; wide tolerance
+        # keeps this deterministic-in-practice without pinning the hash
+        assert 800 <= kept <= 1200
+
+    def test_slow_outliers_always_kept_with_weight_one(self):
+        pol = SamplingPolicy.parse("1/8+slow:250")
+        rate_kept = next(i for i in range(1, 100) if _mix64(i) % 8 == 0)
+        dropped = next(i for i in range(1, 100) if _mix64(i) % 8 != 0)
+        # the 1/N rule wins (weight N) even when the span is also slow
+        assert pol.keep_weight(rate_kept, 10.0) == 8
+        # a slow span missed by the rate rule represents only itself
+        assert pol.keep_weight(dropped, 0.300) == 1
+        # at-threshold counts as slow; below threshold drops
+        assert pol.keep_weight(dropped, 0.250) == 1
+        assert pol.keep_weight(dropped, 0.249) == 0
+
+    def test_all_keeps_everything(self):
+        pol = SamplingPolicy.parse("all")
+        assert pol.samples_all
+        assert all(pol.keep_weight(i, 0.0) == 1 for i in range(1, 100))
+
+
+class TestRollupAggregator:
+    def _agg(self, window_s=30.0, t0=1000.0):
+        sink = io.StringIO()
+        clock = {"t": t0}
+        agg = RollupAggregator(ExchangeJournal(sink), window_s=window_s,
+                               process_index=0,
+                               clock=lambda: clock["t"])
+        return agg, sink, clock
+
+    def _lines(self, sink):
+        return [json.loads(ln) for ln in sink.getvalue().splitlines()]
+
+    def test_totals_exact_even_when_spans_sampled_out(self):
+        """The acceptance invariant: dropping a span's full line must
+        not change window totals — only ``sampled_reads``."""
+        agg, sink, _ = self._agg()
+        spans = [make_span(span_id=i, records=100 + i, dispatches=d,
+                           retry_count=i % 2, exchange_s=0.001 * i)
+                 for i, d in zip(range(1, 9), (1, 3, 1, 4, 1, 1, 2, 1))]
+        for i, s in enumerate(spans):
+            agg.observe(s, kept=(i % 2 == 0))   # half sampled away
+        agg.flush()
+        (rb,) = self._lines(sink)
+        assert set(rb) == ROLLUP_FIELDS
+        assert rb["kind"] == "rollup" and rb["schema"] == SCHEMA_VERSION
+        assert rb["reads"] == 8
+        assert rb["sampled_reads"] == 4
+        assert rb["records"] == sum(s.records for s in spans)
+        assert rb["bytes"] == sum(s.total_bytes for s in spans)
+        assert rb["retries"] == sum(s.retry_count for s in spans)
+        assert rb["streaming_reads"] == 3      # dispatches > 1
+        assert rb["fused_reads"] == 5
+        assert sum(rb["lat_buckets"]) == 8
+        assert rb["lat_max_ms"] == pytest.approx(
+            max(span_latency_ms(s) for s in spans), rel=1e-3)
+        assert rb["p50_ms"] <= rb["p95_ms"] <= rb["p99_ms"] \
+            <= rb["lat_max_ms"] + 1e-9
+
+    def test_window_boundary_emits_closed_window(self):
+        agg, sink, clock = self._agg(window_s=30.0, t0=1000.0)
+        agg.observe(make_span(span_id=1, shuffle_id=7))
+        clock["t"] = 1031.0                     # next wall-aligned window
+        agg.observe(make_span(span_id=2, shuffle_id=7))
+        agg.flush()
+        first, second = self._lines(sink)
+        assert first["reads"] == 1 and second["reads"] == 1
+        assert first["window_start"] < second["window_start"]
+        assert first["window_start"] % 30.0 == 0.0
+
+    def test_per_shuffle_cells(self):
+        agg, sink, _ = self._agg()
+        agg.observe(make_span(span_id=1, shuffle_id=3))
+        agg.observe(make_span(span_id=2, shuffle_id=5))
+        agg.observe(make_span(span_id=3, shuffle_id=3))
+        agg.flush()
+        lines = self._lines(sink)
+        assert [rb["shuffle_id"] for rb in lines] == [3, 5]
+        assert [rb["reads"] for rb in lines] == [2, 1]
+
+    def test_spill_count_is_cumulative_delta(self):
+        """spill_count on a span is process-cumulative; windows must
+        report the delta, not re-count history."""
+        agg, sink, _ = self._agg()
+        for i, cum in enumerate((0, 3, 3, 5), start=1):
+            agg.observe(make_span(span_id=i, spill_count=cum))
+        agg.flush()
+        (rb,) = self._lines(sink)
+        assert rb["spills"] == 5
+
+    def test_flush_with_no_observations_is_silent(self):
+        agg, sink, _ = self._agg()
+        agg.flush()
+        assert sink.getvalue() == ""
+
+
+class TestJournalRotation:
+    def test_rotation_boundary_loses_nothing(self, tmp_path):
+        """Spans written across several rotations are all readable,
+        exactly once, oldest-first."""
+        path = str(tmp_path / "journal.jsonl")
+        reg = MetricsRegistry()
+        journal = ExchangeJournal(path, metrics=reg, max_bytes=1500)
+        for i in range(1, 41):
+            journal.emit(make_span(span_id=i))
+        journal.close()
+        assert journal.rotations > 0
+        segments = rotated_paths(path)
+        # the live file is absent when the very last emit triggered the
+        # rotation — rotated_paths then lists only the .N segments
+        live = os.path.exists(path)
+        assert len(segments) == journal.rotations + (1 if live else 0)
+        ids = [s.span_id for s in read_journal(path, include_rotated=True)]
+        assert ids == list(range(1, 41))       # no loss, no dup, in order
+        if live:
+            assert segments[-1] == path        # live segment listed last
+            # without include_rotated only the live tail is visible
+            live_ids = [s.span_id for s in read_journal(path)]
+            assert live_ids == ids[-len(live_ids):] and len(live_ids) < 40
+        assert reg.snapshot()["journal.rotations"] == journal.rotations
+
+    def test_rotation_interleaves_auxiliary_lines(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = ExchangeJournal(path, max_bytes=800)
+        for i in range(1, 11):
+            journal.emit(make_span(span_id=i))
+            journal.emit_raw({"kind": "heartbeat", "seq": i})
+        journal.close()
+        entries = read_entries(path, include_rotated=True)
+        assert len(entries) == 20
+        assert [e["span_id"] for e in entries
+                if e.get("kind") is None] == list(range(1, 11))
+        assert [e["seq"] for e in entries
+                if e.get("kind") == "heartbeat"] == list(range(1, 11))
+
+    def test_unrotated_paths_and_corrupt_lines(self, tmp_path):
+        path = str(tmp_path / "solo.jsonl")
+        assert rotated_paths(path) == [path]   # nonexistent: just itself
+        with open(path, "w") as f:
+            f.write(json.dumps(make_span(span_id=1).to_dict()) + "\n")
+            f.write('{"span_id": 2, "trunca\n')          # killed mid-write
+            f.write("[1, 2]\n")                          # not an object
+            f.write(json.dumps(make_span(span_id=3).to_dict()) + "\n")
+        errors = []
+        entries = read_entries(path, errors=errors)
+        assert [e["span_id"] for e in entries] == [1, 3]
+        assert len(errors) == 2 and all("solo.jsonl:" in e for e in errors)
+
+
+class TestHeartbeat:
+    def test_beat_fields_and_probe_failure(self):
+        sink = io.StringIO()
+        hb = HeartbeatEmitter(
+            ExchangeJournal(sink), interval_s=3600.0,
+            identity={"process_index": 2, "host_count": 4,
+                      "host": "worker-2", "pid": 4242},
+            probes={"in_flight": lambda: 3,
+                    "pool_outstanding": lambda: 1 / 0})  # probe blows up
+        hb.beat(now=hb._started_at + 12.5)
+        hb.beat(now=hb._started_at + 13.0)
+        lines = [json.loads(ln) for ln in sink.getvalue().splitlines()]
+        assert len(lines) == 2
+        d = lines[0]
+        assert set(d) == HEARTBEAT_FIELDS
+        assert d["kind"] == "heartbeat" and d["schema"] == SCHEMA_VERSION
+        assert d["process_index"] == 2 and d["host"] == "worker-2"
+        assert d["pid"] == 4242 and d["uptime_s"] == pytest.approx(12.5)
+        assert d["in_flight"] == 3
+        assert d["pool_outstanding"] == -1     # failed probe, not a crash
+        assert [ln["seq"] for ln in lines] == [1, 2]
+        assert hb.beat_errors == 0
+
+    def test_beat_never_raises(self):
+        class Exploding:
+            enabled = True
+
+            def emit_raw(self, d):
+                raise RuntimeError("disk gone")
+
+        hb = HeartbeatEmitter(Exploding(), interval_s=3600.0)
+        hb.beat()                              # must not propagate
+        assert hb.beat_errors == 1
+
+    def test_stop_emits_final_beat(self):
+        sink = io.StringIO()
+        hb = HeartbeatEmitter(ExchangeJournal(sink), interval_s=3600.0)
+        hb.start()
+        hb.stop()                              # long interval: only the
+        lines = sink.getvalue().splitlines()   # final beat ever fires
+        assert len(lines) == 1
+
+    def test_shuffle_top_flags_stale_hosts(self):
+        """The liveness contract: a host whose newest heartbeat is older
+        than ``--stale`` shows STALE; a fresh one doesn't."""
+        def beat(pidx, ts):
+            return {"kind": "heartbeat", "schema": 3, "ts": ts, "seq": 1,
+                    "process_index": pidx, "host_count": 2,
+                    "host": f"h{pidx}", "pid": 1, "uptime_s": ts,
+                    "in_flight": 0, "pool_outstanding": 0,
+                    "spans_emitted": 0, "rotations": 0, "rss_mb": 100.0}
+
+        kinds = _kinds(heartbeat=[beat(0, 995.0), beat(1, 940.0),
+                                  beat(1, 930.0)])   # newest-wins per host
+        rows = shuffle_top.build_host_rows(kinds, now=1000.0, stale_s=15.0,
+                                           rate_window_s=60.0)
+        by_pidx = {r.process_index: r for r in rows}
+        assert not by_pidx[0].stale and by_pidx[0].hb_age == \
+            pytest.approx(5.0)
+        assert by_pidx[1].stale and by_pidx[1].hb_age == pytest.approx(60.0)
+        text = shuffle_top.render(kinds, now=1000.0, stale_s=15.0,
+                                  rate_window_s=60.0)
+        assert "STALE" in text
+
+
+#: the exact field set a schema-v2 journal line carried (PR 2); the
+#: cross-version tests pin the v2 <-> v3 compat contract to it
+V2_FIELDS = ("span_id", "shuffle_id", "transport", "rounds", "dispatches",
+             "records", "record_bytes", "plan_s", "exchange_s", "sort_s",
+             "per_peer_records", "pool_high_water", "spill_count",
+             "retry_count", "process_index", "host_count", "events",
+             "ts", "schema", "total_bytes")
+
+
+class TestSchemaV2V3:
+    def test_v2_line_parses_under_v3_reader(self):
+        d = make_span(span_id=9).to_dict()
+        del d["sample_weight"]                 # what a v2 writer emitted
+        d["schema"] = 2
+        span = ExchangeSpan.from_dict(d)
+        assert span.sample_weight == 1         # v2 spans stand for 1 read
+        assert span.span_id == 9 and span.schema == 2
+
+    def test_v3_line_readable_by_v2_reader(self):
+        """Emulate the v2 drop-unknown-keys reader over a v3 line: every
+        v2 field must survive (no rename/removal), and the v3-only
+        extras must be exactly the droppable set."""
+        d = make_span(sample_weight=8).to_dict()
+        missing = [f for f in V2_FIELDS if f not in d]
+        assert not missing, f"v3 line lost v2 fields: {missing}"
+        assert set(d) - set(V2_FIELDS) == {"sample_weight"}
+        v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
+        span = ExchangeSpan.from_dict(v2_view)
+        assert span.records == d["records"]
+        assert span.sample_weight == 1         # invisible to a v2 reader
+
+    def test_span_readers_skip_auxiliary_kinds(self, tmp_path):
+        path = str(tmp_path / "mixed.jsonl")
+        journal = ExchangeJournal(path)
+        journal.emit(make_span(span_id=1))
+        journal.emit_raw({"kind": "rollup", "shuffle_id": 0, "reads": 1})
+        journal.emit_raw({"kind": "heartbeat", "seq": 1})
+        journal.emit_raw({"kind": "from_the_future", "x": 1})
+        journal.close()
+        (span,) = read_journal(path)           # spans only
+        assert span.span_id == 1
+        kinds = shuffle_top.collect([path])    # known kinds bucketed,
+        assert len(kinds["span"]) == 1         # unknown ones dropped
+        assert len(kinds["rollup"]) == 1
+        assert len(kinds["heartbeat"]) == 1
+
+
+class TestShuffleTopRows:
+    def _rollup(self, sid, reads, records, nbytes, p95=2.0):
+        return {"kind": "rollup", "shuffle_id": sid, "process_index": 0,
+                "window_start": 0.0, "window_s": 30.0, "reads": reads,
+                "records": records, "bytes": nbytes, "spills": 0,
+                "retries": 0, "p95_ms": p95}
+
+    def test_shuffle_rows_prefer_exact_rollups(self):
+        spans = [make_span(span_id=i, sample_weight=4).to_dict()
+                 for i in (4, 8)]               # 2 kept of ~8 reads
+        kinds = _kinds(span=spans,
+                       rollup=[self._rollup(0, 8, 320, 5120)])
+        (row,) = shuffle_top.build_shuffle_rows(kinds)
+        assert row["exact"] and row["reads"] == 8
+        assert row["records"] == 320 and row["bytes"] == 5120
+
+    def test_shuffle_rows_estimate_from_spans_without_rollups(self):
+        spans = [make_span(span_id=i, sample_weight=4).to_dict()
+                 for i in (4, 8)]
+        (row,) = shuffle_top.build_shuffle_rows(_kinds(span=spans))
+        assert not row["exact"]
+        assert row["reads"] == 8               # 2 spans x weight 4
+        assert row["records"] == 2 * 40 * 4
+        assert row["bytes"] == 2 * 40 * 16 * 4
+
+    def test_host_rows_scale_reads_by_weight(self):
+        spans = [make_span(span_id=i, sample_weight=4,
+                           ts=100.0).to_dict() for i in (4, 8)]
+        (row,) = shuffle_top.build_host_rows(
+            _kinds(span=spans), now=110.0, stale_s=15.0,
+            rate_window_s=60.0)
+        assert row.reads == 2 and row.est_reads == 8
+
+
+def _position_span_counter(rate, n):
+    """Advance the global span-id counter to a spot where, of the next
+    ``n`` ids, at least one is rate-kept and at least one is dropped,
+    and return the kept ids. Keeps the E2E sampling assertions exact
+    (the policy is a pure function of the id) without depending on how
+    many spans earlier tests emitted."""
+    for _ in range(8 * rate * n):
+        nxt = next_span_id()
+        window = range(nxt + 1, nxt + 1 + n)
+        kept = [i for i in window if _mix64(i) % rate == 0]
+        if 0 < len(kept) < n:
+            return kept
+    raise AssertionError("could not position span counter")
+
+
+class TestManagerSamplingE2E:
+    """The acceptance path: real reads under journal_sample, compared
+    against an unsampled control run."""
+
+    N_READS = 12
+
+    def _run_reads(self, conf, rng, shuffle_id, position=None):
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = manager.runtime.num_partitions
+            handle = manager.register_shuffle(
+                shuffle_id, mesh, modulo_partitioner(mesh))
+            x = rng.integers(1, 2**32, size=(mesh * 16, 4),
+                             dtype=np.uint32)
+            manager.get_writer(handle).write(
+                manager.runtime.shard_records(x)).stop(True)
+            reader = manager.get_reader(handle)
+            expected = position() if position is not None else None
+            for _ in range(self.N_READS):
+                reader.read()
+            return expected, x.shape[0], manager.metrics.snapshot()
+        finally:
+            manager.stop()
+
+    def test_sampled_journal_vs_unsampled_control(self, tmp_path, rng):
+        sampled = tmp_path / "sampled.jsonl"
+        control = tmp_path / "control.jsonl"
+        conf_s = ShuffleConf(slot_records=64, metrics_sink=str(sampled),
+                             journal_sample="1/4", rollup_window_s=3600.0)
+        expected, n_records, snap = self._run_reads(
+            conf_s, rng, shuffle_id=70,
+            position=lambda: _position_span_counter(4, self.N_READS))
+        spans = read_journal(str(sampled))
+        # exactly the deterministically-chosen ids landed, weight N each
+        assert [s.span_id for s in spans] == expected
+        assert all(s.sample_weight == 4 for s in spans)
+        assert snap["journal.sampled_out"] == self.N_READS - len(expected)
+        rollups = [e for e in read_entries(str(sampled))
+                   if e.get("kind") == "rollup"]
+        assert sum(rb["reads"] for rb in rollups) == self.N_READS
+        assert sum(rb["sampled_reads"] for rb in rollups) == len(expected)
+
+        conf_c = ShuffleConf(slot_records=64, metrics_sink=str(control),
+                             rollup_window_s=3600.0)   # journal_sample=all
+        _, _, _ = self._run_reads(conf_c, rng, shuffle_id=70)
+        control_spans = read_journal(str(control))
+        assert len(control_spans) == self.N_READS
+        assert all(s.sample_weight == 1 for s in control_spans)
+        control_rollups = [e for e in read_entries(str(control))
+                           if e.get("kind") == "rollup"]
+        # the headline guarantee: sampling did not move the aggregates
+        for key in ("reads", "records", "bytes"):
+            assert sum(rb[key] for rb in rollups) == \
+                sum(rb[key] for rb in control_rollups), key
+        assert sum(rb["records"] for rb in rollups) == \
+            self.N_READS * n_records
+
+        # shuffle_report flags the sampled journal and scales counts up
+        rep = shuffle_report.aggregate([s.to_dict() for s in spans])
+        assert rep["sampling"]["sampled"]
+        assert rep["sampling"]["estimated_reads"] == 4 * len(expected)
+        ctl = shuffle_report.aggregate(
+            [s.to_dict() for s in control_spans])
+        assert not ctl["sampling"]["sampled"]
+        assert ctl["sampling"]["estimated_reads"] == self.N_READS
+
+    def test_report_and_top_render_sampled_rotated_journal(
+            self, tmp_path, rng, capsys):
+        sink = tmp_path / "rotated.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                           journal_sample="1/4", rollup_window_s=3600.0,
+                           heartbeat_s=3600.0,   # one final beat at stop
+                           journal_max_bytes=2000)
+        expected, _, _ = self._run_reads(
+            conf, rng, shuffle_id=71,
+            position=lambda: _position_span_counter(4, self.N_READS))
+        segments = rotated_paths(str(sink))
+        assert len(segments) > 1, "journal must have rotated"
+        entries = read_entries(str(sink), include_rotated=True)
+        assert [e["span_id"] for e in entries
+                if e.get("kind") is None] == expected
+        assert any(e.get("kind") == "heartbeat" for e in entries)
+
+        assert shuffle_report.main([str(sink)]) == 0
+        out = capsys.readouterr().out
+        assert "SAMPLED" in out and "rollup" in out
+
+        assert shuffle_top.main([str(sink), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "shuffle 71" in out or "71" in out
+        assert "HOST" in out and "SHUFFLE" in out
