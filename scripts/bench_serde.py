@@ -85,12 +85,27 @@ def main(argv=None) -> int:
     payloads = [whole[i * 96: i * 96 + ln]
                 for i, ln in enumerate(lens.tolist())]
     # time ONLY the codec (input synthesis above is test scaffolding,
-    # not serializer work — review finding)
+    # not serializer work — review finding); native codec + threads when
+    # the library is built, numpy fallback otherwise — the JSON line
+    # says which ran
+    from sparkrdma_tpu.api.serde import native_codec_available
+
+    native = native_codec_available()
     t0 = time.perf_counter()
     rows = encode_bytes_rows(keys, payloads, MAX_PAYLOAD)
     encode_s = time.perf_counter() - t0
     w = rows.shape[1]
     assert w == 2 + payload_words(MAX_PAYLOAD)
+    # host decode over the full encoded batch — the symmetric number
+    # (wire bytes back into payload bytes), separate from device GB/s
+    t0 = time.perf_counter()
+    dec_keys, dec_payloads = decode_bytes_rows(rows, 2)
+    decode_s = time.perf_counter() - t0
+    if not (np.array_equal(dec_keys, keys)
+            and dec_payloads[:256] == payloads[:256]):
+        print(json.dumps({"error": "host codec round trip FAILED"}))
+        return 1
+    del dec_keys, dec_payloads
 
     conf = ShuffleConf(slot_records=max(4096, n), max_rounds=64,
                        max_slot_records=max(1 << 22, 2 * n),
@@ -133,7 +148,9 @@ def main(argv=None) -> int:
             "unit": "GB/s/chip",
             "record_bytes": w * 4,
             "payload": "variable 0-92B, mean ~46B",
-            "host_encode_mbps": round(n * w * 4 / encode_s / 1e6, 1),
+            "encode_mbps": round(n * w * 4 / encode_s / 1e6, 1),
+            "decode_mbps": round(n * w * 4 / decode_s / 1e6, 1),
+            "serde_native": native,
             "decoded_rows_verified": checked,
             "metrics": _bench_metrics(manager),
         }))

@@ -137,6 +137,9 @@ def aggregate(spans: List[dict]) -> dict:
     retries = 0
     pool_high_water = 0
     spills = 0
+    # serde codec totals are PROCESS-CUMULATIVE (schema v4): the true
+    # total is the max per process, summed across processes
+    serde_by_host: Dict[int, Tuple[float, float, float, float]] = {}
     for s in spans:
         for k in phases:
             phases[k] += float(s.get(k, 0.0))
@@ -152,6 +155,14 @@ def aggregate(spans: List[dict]) -> dict:
         pool_high_water = max(pool_high_water,
                               int(s.get("pool_high_water", 0)))
         spills = max(spills, int(s.get("spill_count", 0)))
+        host = int(s.get("process_index", 0) or 0)
+        cum = (float(s.get("serde_encode_bytes", 0) or 0),
+               float(s.get("serde_encode_s", 0.0) or 0.0),
+               float(s.get("serde_decode_bytes", 0) or 0),
+               float(s.get("serde_decode_s", 0.0) or 0.0))
+        prev = serde_by_host.get(host)
+        if prev is None or cum > prev:
+            serde_by_host[host] = cum
         sid = int(s.get("shuffle_id", -1))
         agg = per_shuffle.setdefault(sid, {
             "spans": 0, "records": 0, "rounds": 0,
@@ -190,6 +201,23 @@ def aggregate(spans: List[dict]) -> dict:
         "estimated_records": est_records,
         "estimated_bytes": est_bytes,
     }
+    enc_b = sum(v[0] for v in serde_by_host.values())
+    enc_s = sum(v[1] for v in serde_by_host.values())
+    dec_b = sum(v[2] for v in serde_by_host.values())
+    dec_s = sum(v[3] for v in serde_by_host.values())
+    exchange_s = phases["exchange_s"]
+    serde = {
+        "encode_bytes": int(enc_b),
+        "encode_s": round(enc_s, 6),
+        "encode_mbps": round(enc_b / enc_s / 1e6, 3) if enc_s > 0 else 0.0,
+        "decode_bytes": int(dec_b),
+        "decode_s": round(dec_s, 6),
+        "decode_mbps": round(dec_b / dec_s / 1e6, 3) if dec_s > 0 else 0.0,
+        # the fabric's delivered rate over the same journal — the number
+        # the host codec must beat for the path to be fabric-bound
+        "fabric_mbps": round(total_bytes / exchange_s / 1e6, 3)
+        if exchange_s > 0 else 0.0,
+    }
     return {
         "spans": len(spans),
         "sampling": sampling,
@@ -201,6 +229,7 @@ def aggregate(spans: List[dict]) -> dict:
         "retries": retries,
         "pool_high_water": pool_high_water,
         "spill_count": spills,
+        "serde": serde,
         "phases": {k: round(v, 6) for k, v in phases.items()},
         "phase_share": {
             k: round(v / wall, 4) if wall > 0 else 0.0
@@ -248,7 +277,11 @@ def aggregate_rollups(rollups: List[dict]) -> dict:
         return {"windows": 0}
     sums = {"reads": 0, "sampled_reads": 0, "records": 0, "bytes": 0,
             "rounds": 0, "dispatches": 0, "retries": 0, "spills": 0,
-            "streaming_reads": 0, "fused_reads": 0}
+            "streaming_reads": 0, "fused_reads": 0,
+            "serde_encode_bytes": 0, "serde_decode_bytes": 0}
+    # windows carry (bytes, MB/s); merging recovers the implied seconds
+    # so the merged rate stays a proper weighted harmonic mean
+    enc_s = dec_s = 0.0
     per_shuffle: Dict[int, dict] = {}
     bounds: Optional[List[float]] = None
     merged: Optional[List[int]] = None
@@ -271,7 +304,17 @@ def aggregate_rollups(rollups: List[dict]) -> dict:
                     if i < len(merged):
                         merged[i] += int(n)
         lat_max = max(lat_max, float(rb.get("lat_max_ms", 0.0) or 0.0))
+        em = float(rb.get("serde_encode_mbps", 0.0) or 0.0)
+        dm = float(rb.get("serde_decode_mbps", 0.0) or 0.0)
+        if em > 0:
+            enc_s += int(rb.get("serde_encode_bytes", 0) or 0) / (em * 1e6)
+        if dm > 0:
+            dec_s += int(rb.get("serde_decode_bytes", 0) or 0) / (dm * 1e6)
     out = dict(sums)
+    out["serde_encode_mbps"] = round(
+        sums["serde_encode_bytes"] / enc_s / 1e6, 3) if enc_s > 0 else 0.0
+    out["serde_decode_mbps"] = round(
+        sums["serde_decode_bytes"] / dec_s / 1e6, 3) if dec_s > 0 else 0.0
     out["windows"] = len(rollups)
     out["shuffles"] = len(per_shuffle)
     out["per_shuffle"] = {str(k): v
@@ -379,6 +422,17 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             f"{stalled}: a blocking wait exceeded watchdog_timeout_s — "
             "inspect the journaled stall lines (queue occupancy, pool "
             "high-water) and the Perfetto trace (scripts/shuffle_trace.py)")
+    serde = aggregate(spans).get("serde") or {} if spans else {}
+    verdict = _bound_verdict(serde)
+    if verdict.startswith("CODEC"):
+        findings.append(
+            f"byte-payload path is codec-bound (host serde "
+            f"{min(r for r in (serde['encode_mbps'], serde['decode_mbps']) if r > 0):,.1f} MB/s "
+            f"vs fabric {serde['fabric_mbps']:,.1f} MB/s): enable the "
+            "native codec (ShuffleConf(serde_native=True), build "
+            "native/ with make) and raise serde_threads; the timeline's "
+            "serde:encode/serde:h2d events show whether encode or the "
+            "host copy is the slow stage")
     retried = sorted({int(s.get("shuffle_id", -1)) for s in spans
                       if int(s.get("retry_count", 0)) > 0})
     if retried:
@@ -390,6 +444,20 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
         findings.append("no issues detected: skew, spills, stalls and "
                         "retries all within normal bounds")
     return findings
+
+
+def _bound_verdict(sd: dict) -> str:
+    """Which side of the host<->device boundary limits the byte-payload
+    path: the slower codec direction vs. the fabric's delivered rate."""
+    rates = [r for r in (sd.get("encode_mbps", 0.0),
+                         sd.get("decode_mbps", 0.0)) if r > 0]
+    fabric = sd.get("fabric_mbps", 0.0)
+    if not rates or fabric <= 0:
+        return "insufficient data"
+    codec = min(rates)
+    if codec < fabric:
+        return f"CODEC-bound: host serde {codec:,.1f} MB/s < fabric"
+    return f"fabric-bound: host serde {codec:,.1f} MB/s >= fabric"
 
 
 def _fmt_bytes(n: int) -> str:
@@ -423,6 +491,16 @@ def print_report(rep: dict, top: int) -> None:
     for k, v in rep["phases"].items():
         share = rep["phase_share"][k]
         print(f"  {k:<11} {v:>10.4f}s  {share:>6.1%}")
+    sd = rep.get("serde") or {}
+    if sd.get("encode_bytes") or sd.get("decode_bytes"):
+        print("host serde codec (cumulative, all processes):")
+        print(f"  encode: {_fmt_bytes(sd['encode_bytes'])} in "
+              f"{sd['encode_s']:.4f}s  ({sd['encode_mbps']:,.1f} MB/s)")
+        print(f"  decode: {_fmt_bytes(sd['decode_bytes'])} in "
+              f"{sd['decode_s']:.4f}s  ({sd['decode_mbps']:,.1f} MB/s)")
+        print(f"  fabric delivered rate over the same spans: "
+              f"{sd['fabric_mbps']:,.1f} MB/s "
+              f"({_bound_verdict(sd)})")
     print("per-peer received records (all spans):")
     peers = rep["per_peer_records"]
     total = sum(peers.values()) or 1
@@ -466,6 +544,12 @@ def print_rollups(roll: dict) -> None:
           f"read latency p50/p95/p99: {roll.get('p50_ms', 0):.1f} / "
           f"{roll.get('p95_ms', 0):.1f} / {roll.get('p99_ms', 0):.1f} ms "
           f"(max {roll['lat_max_ms']:.1f})")
+    if roll.get("serde_encode_bytes") or roll.get("serde_decode_bytes"):
+        print(f"  serde: encode "
+              f"{_fmt_bytes(roll['serde_encode_bytes'])} @ "
+              f"{roll['serde_encode_mbps']:,.1f} MB/s   decode "
+              f"{_fmt_bytes(roll['serde_decode_bytes'])} @ "
+              f"{roll['serde_decode_mbps']:,.1f} MB/s")
     for sid, c in roll["per_shuffle"].items():
         print(f"  shuffle {sid}: {c['reads']:,} reads, "
               f"{c['records']:,} records, {_fmt_bytes(c['bytes'])}, "

@@ -226,7 +226,10 @@ class GroupedData:
                 key = tuple(int(g[k, i]) for k in range(kw))
                 cnt, off = int(g[kw, i]), int(g[kw + 1, i])
                 rows = vals[kw:, d * cap + off: d * cap + off + cnt].T
-                assert key not in out, "key on two devices"
+                if key in out:  # not an assert: must hold under python -O
+                    raise RuntimeError(
+                        f"grouped key {key} appears on two devices — "
+                        "exchange partitioning invariant violated")
                 out[key] = rows
         return out
 
@@ -261,7 +264,10 @@ class CoGroupedData:
             t = ct[:, d * cu: d * cu + int(self.union_totals[d])]
             for i in range(t.shape[1]):
                 key = tuple(int(t[k, i]) for k in range(kw))
-                assert key not in out, "key on two devices"
+                if key in out:  # not an assert: must hold under python -O
+                    raise RuntimeError(
+                        f"cogrouped key {key} appears on two devices — "
+                        "exchange partitioning invariant violated")
                 na, oa = int(t[kw, i]), int(t[kw + 1, i])
                 nb, ob = int(t[kw + 2, i]), int(t[kw + 3, i])
                 out[key] = (va[kw:, d * ca + oa: d * ca + oa + na].T,
@@ -301,6 +307,53 @@ class Dataset:
                 "which this layer reserves for padding filler — remap "
                 "that key before loading")
         return cls(manager, manager.runtime.shard_records(rows))
+
+    @classmethod
+    def from_host_payloads(cls, manager: ShuffleManager, keys: np.ndarray,
+                           payloads, max_payload_bytes: int, *,
+                           chunk_records: Optional[int] = None,
+                           overlap: bool = True) -> "Dataset":
+        """Byte payloads -> device Dataset via the pipelined serde path.
+
+        ``keys`` is ``[N, key_words]`` uint32 (``N`` divisible by mesh),
+        ``payloads`` a sequence of ``N`` bytes-like values each at most
+        ``max_payload_bytes`` long. Encoding (native codec when built)
+        overlaps with the H2D transfer chunk by chunk — see
+        ``api/pipeline.py``. The record geometry must match the
+        manager's exchange config: ``payload_words(max_payload_bytes)``
+        must equal ``conf.val_words`` so the loaded rows are exchangeable.
+        """
+        from sparkrdma_tpu.api.pipeline import encode_rows_to_device
+        from sparkrdma_tpu.api.serde import payload_words
+
+        conf = manager.conf
+        pw = payload_words(max_payload_bytes)
+        if pw != conf.val_words:
+            raise ValueError(
+                f"max_payload_bytes={max_payload_bytes} needs "
+                f"val_words={pw} but the manager was configured with "
+                f"val_words={conf.val_words} — size the ShuffleConf with "
+                f"payload_words(max_payload_bytes)")
+        keys = np.asarray(keys)
+        if keys.ndim == 2 and keys.size and \
+                bool((keys == _NULL).all(axis=1).any()):
+            raise ValueError(
+                "input keys use the reserved all-ones (0xFFFFFFFF) key, "
+                "which this layer reserves for padding filler — remap "
+                "that key before loading")
+        records = encode_rows_to_device(
+            manager, keys, payloads, max_payload_bytes,
+            chunk_records=chunk_records, overlap=overlap)
+        return cls(manager, records)
+
+    def to_host_payloads(self, *, overlap: bool = True):
+        """Inverse of :meth:`from_host_payloads`: ``(keys [N, kw] uint32,
+        payloads list[bytes])`` with filler rows dropped, decoding each
+        device window while the next window's D2H copy is in flight."""
+        from sparkrdma_tpu.api.pipeline import decode_rows_from_device
+
+        return decode_rows_from_device(self.manager, self.records,
+                                       self.totals, overlap=overlap)
 
     def to_host_rows(self) -> np.ndarray:
         """Valid records only, concatenated in device order (reserved

@@ -1,0 +1,282 @@
+"""Pipelined byte-payload host<->device path (encode/transfer overlap).
+
+The serde codec (``api/serde.py``) turns variable-length byte payloads
+into fixed-width uint32 rows on the host; ``MeshRuntime.shard_records``
+moves rows to the device mesh. Done naively those two stages run back to
+back, so the end-to-end load rate is ``1/(1/encode + 1/h2d)`` — the
+round-5 verdict's "codec-bound at ~124 MB/s against a 3.9 GB/s device
+pipeline". This module chunks large batches and runs the stages as a
+pipeline:
+
+- **encode side** — a producer thread encodes chunk *k+1* into a pooled
+  host staging buffer (:class:`~sparkrdma_tpu.hbm.host_staging
+  .HostBufferPool`) while the main thread transfers chunk *k* to the
+  device; a bounded hand-off queue of depth 2 double-buffers the
+  staging memory, so at most three chunks of host memory are live.
+- **decode side** — symmetrically, a prefetch thread pulls device
+  window *d+1* down to the host (D2H) while the main thread decodes
+  window *d*'s payload bytes.
+
+PLACEMENT EQUIVALENCE: the pipelined loader produces a bit-identical
+device layout to the single-shot ``encode -> shard_records`` path.
+``shard_records`` gives device ``d`` the contiguous row range
+``rows[d*N/mesh : (d+1)*N/mesh]``, so each pipeline chunk gathers the
+*next slice of every device's range* (not the next contiguous slice of
+the input), and the per-device chunk shards are concatenated on-device
+at the end. Overlap on vs. off is therefore an implementation detail,
+never a layout change — the invariant the overlap equivalence test in
+``tests/test_pipeline.py`` pins.
+
+Stage occupancy is recorded on the active obs timeline as ``B``/``E``
+duration pairs (``serde:encode`` / ``serde:h2d`` / ``serde:d2h`` /
+``serde:decode``) so a Perfetto export of the next journal span shows
+which stage the wall-clock went to; byte/second totals ride the global
+metrics registry via the serde codec itself (``serde.*`` counters).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from queue import Queue
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.api.serde import (decode_bytes_rows, encode_bytes_rows,
+                                     payload_words)
+from sparkrdma_tpu.obs.timeline import record_active
+
+#: reserved all-ones filler key (see api/dataset.py module docstring)
+_NULL = np.uint32(0xFFFFFFFF)
+
+#: encode->transfer hand-off depth: chunk k in flight on the device,
+#: chunk k+1 encoded and queued, chunk k+2 being encoded = classic
+#: double buffering through the staging pool.
+_QUEUE_DEPTH = 2
+
+# ---------------------------------------------------------------------
+# process-wide host staging pool — lazily built, shared by every
+# pipelined load in the process so chunk buffers recycle across calls
+# (the RdmaBufferManager is one-per-node in the reference too).
+# ---------------------------------------------------------------------
+_pool = None
+_pool_lock = threading.Lock()
+
+
+def staging_pool():
+    """The process-wide :class:`HostBufferPool` used for chunk staging."""
+    global _pool
+    if _pool is None:
+        from sparkrdma_tpu.hbm.host_staging import HostBufferPool
+
+        with _pool_lock:
+            if _pool is None:
+                _pool = HostBufferPool()
+    return _pool
+
+
+def _chunk_rows(conf, n: int, mesh: int,
+                chunk_records: Optional[int]) -> int:
+    """Per-chunk row count: ``serde_chunk_records`` rounded down to a
+    multiple of the mesh size (every chunk must shard evenly). 0 (or a
+    value >= n) disables chunking entirely."""
+    chunk = conf.serde_chunk_records if chunk_records is None else chunk_records
+    if chunk <= 0:
+        return 0
+    return max(mesh, (chunk // mesh) * mesh)
+
+
+def _gather_chunk(keys: np.ndarray, payloads: Sequence, per: int,
+                  lo: int, hi: int, mesh: int) -> Tuple[np.ndarray, list]:
+    """Rows ``lo:hi`` of EVERY device's contiguous range (see module
+    docstring's placement-equivalence note)."""
+    ck = np.concatenate([keys[d * per + lo: d * per + hi]
+                         for d in range(mesh)])
+    cp: list = []
+    for d in range(mesh):
+        cp.extend(payloads[d * per + lo: d * per + hi])
+    return np.ascontiguousarray(ck), cp
+
+
+def _assemble(runtime, chunks: List[jax.Array]) -> jax.Array:
+    """Concatenate per-chunk sharded batches along the record axis
+    WITHOUT leaving the device: each device's final shard is the
+    concatenation of its per-chunk shards, reassembled into one global
+    array (no cross-device traffic, no host round-trip)."""
+    if len(chunks) == 1:
+        return chunks[0]
+    by_dev: dict = {}
+    for ch in chunks:
+        for s in ch.addressable_shards:
+            by_dev.setdefault(s.device, []).append(s.data)
+    parts = [jnp.concatenate(datas, axis=1) for datas in by_dev.values()]
+    w = chunks[0].shape[0]
+    n = sum(int(ch.shape[1]) for ch in chunks)
+    return jax.make_array_from_single_device_arrays(
+        (w, n), runtime.sharding(None, runtime.axis_name), parts)
+
+
+def encode_rows_to_device(manager, keys: np.ndarray, payloads: Sequence,
+                          max_payload_bytes: int, *,
+                          chunk_records: Optional[int] = None,
+                          overlap: bool = True) -> jax.Array:
+    """Encode byte payloads into uint32 rows and shard them onto the
+    device mesh, overlapping host encode with H2D transfer.
+
+    Returns the columnar device batch ``u32[W, N]`` (the exact array
+    ``runtime.shard_records(encode_bytes_rows(...))`` would produce).
+    """
+    conf = manager.conf
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint32))
+    if keys.ndim == 1:
+        keys = keys[:, None]
+    n = keys.shape[0]
+    if len(payloads) != n:
+        raise ValueError(f"{n} keys but {len(payloads)} payloads")
+    native = conf.serde_native
+    threads = conf.serde_threads or None
+    chunk = _chunk_rows(conf, n, mesh, chunk_records)
+    if chunk == 0 or n <= chunk or n % mesh != 0:
+        # single shot: nothing to overlap (or rows don't shard evenly —
+        # let shard_records surface that as it always has)
+        rows = encode_bytes_rows(keys, payloads, max_payload_bytes,
+                                 native=native, threads=threads)
+        return rt.shard_records(rows)
+
+    per = n // mesh           # rows per device, total
+    cc = chunk // mesh        # rows per device, per chunk
+    bounds = [(lo, min(per, lo + cc)) for lo in range(0, per, cc)]
+    w = keys.shape[1] + payload_words(max_payload_bytes)
+    pool = staging_pool()
+
+    def encode_chunk(ci: int, lo: int, hi: int):
+        c = (hi - lo) * mesh
+        buf = pool.get(c * w * 4)
+        out = buf.view(np.uint32, (c, w))
+        ck, cp = _gather_chunk(keys, payloads, per, lo, hi, mesh)
+        record_active("serde:encode", ph="B", chunk=ci, rows=c)
+        encode_bytes_rows(ck, cp, max_payload_bytes,
+                          native=native, threads=threads, out=out)
+        record_active("serde:encode", ph="E", chunk=ci)
+        return buf, out
+
+    def transfer(ci: int, buf, out) -> jax.Array:
+        record_active("serde:h2d", ph="B", chunk=ci, rows=out.shape[0])
+        arr = rt.shard_records(out)
+        # shard_records copies through a fresh transpose before the
+        # device put, so the staging buffer is dead once it returns
+        buf.release()
+        record_active("serde:h2d", ph="E", chunk=ci)
+        return arr
+
+    chunks: List[jax.Array] = []
+    if not overlap:
+        for ci, (lo, hi) in enumerate(bounds):
+            buf, out = encode_chunk(ci, lo, hi)
+            chunks.append(transfer(ci, buf, out))
+        return _assemble(rt, chunks)
+
+    q: Queue = Queue(maxsize=_QUEUE_DEPTH)
+
+    def producer():
+        try:
+            for ci, (lo, hi) in enumerate(bounds):
+                q.put((ci,) + encode_chunk(ci, lo, hi))
+            q.put(None)
+        except BaseException as e:  # surfaced on the consumer side
+            q.put(e)
+
+    t = threading.Thread(target=producer, name="serde-encode", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            ci, buf, out = item
+            chunks.append(transfer(ci, buf, out))
+    finally:
+        t.join()
+    return _assemble(rt, chunks)
+
+
+def decode_rows_from_device(manager, records: jax.Array,
+                            totals, *, overlap: bool = True
+                            ) -> Tuple[np.ndarray, List[bytes]]:
+    """Device columnar batch -> host ``(keys [N, kw] uint32, payloads)``.
+
+    Walks the batch one device window at a time, prefetching window
+    ``d+1``'s D2H copy on a worker thread while window ``d`` decodes on
+    the main thread. Reserved all-ones filler keys are dropped, exactly
+    as ``Dataset.to_host_rows`` drops them; windows are concatenated in
+    device order, so the result matches ``decode_bytes_rows`` applied
+    to ``Dataset.to_host_rows()`` output bit for bit.
+    """
+    conf = manager.conf
+    kw = conf.key_words
+    mesh = manager.runtime.num_partitions
+    cap = records.shape[1] // mesh
+    if cap == 0:
+        return np.empty((0, kw), np.uint32), []
+    tot = np.asarray(totals)
+    native = conf.serde_native
+    threads = conf.serde_threads or None
+    shards = sorted(records.addressable_shards,
+                    key=lambda s: s.index[1].start)
+
+    def fetch(i: int) -> Tuple[int, np.ndarray]:
+        s = shards[i]
+        d = s.index[1].start // cap
+        record_active("serde:d2h", ph="B", device=d)
+        a = np.asarray(s.data)
+        record_active("serde:d2h", ph="E", device=d)
+        return d, a
+
+    def decode(d: int, cols: np.ndarray) -> Tuple[np.ndarray, List[bytes]]:
+        rows = cols[:, : int(tot[d])].T
+        if rows.size:
+            filler = (rows[:, :kw] == _NULL).all(axis=1)
+            if filler.any():
+                rows = rows[~filler]
+        record_active("serde:decode", ph="B", device=d,
+                      rows=int(rows.shape[0]))
+        out = decode_bytes_rows(np.ascontiguousarray(rows), kw,
+                                native=native, threads=threads)
+        record_active("serde:decode", ph="E", device=d)
+        return out
+
+    keys_parts: List[np.ndarray] = []
+    payloads: List[bytes] = []
+
+    def consume(part):
+        k, p = part
+        keys_parts.append(k)
+        payloads.extend(p)
+
+    if not overlap or len(shards) <= 1:
+        for i in range(len(shards)):
+            consume(decode(*fetch(i)))
+    else:
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="serde-d2h") as ex:
+            nxt = ex.submit(fetch, 0)
+            for i in range(len(shards)):
+                d, cols = nxt.result()
+                if i + 1 < len(shards):
+                    nxt = ex.submit(fetch, i + 1)
+                consume(decode(d, cols))
+
+    if not keys_parts:
+        return np.empty((0, kw), np.uint32), []
+    return np.concatenate(keys_parts), payloads
+
+
+__all__ = ["encode_rows_to_device", "decode_rows_from_device",
+           "staging_pool"]

@@ -25,13 +25,51 @@ Encoded batches are ordinary record batches: every exchange feature
 applies unchanged; only the payload INTERPRETATION is byte-level.
 Little-endian byte order within words, fixed by the codec (not host
 order), so encoded batches checkpoint/restore portably.
+
+Two implementations produce bit-identical rows (pinned by the fuzz
+tests):
+
+- **native** (round 6, the default where available): ``sr_encode_rows``
+  / ``sr_decode_rows`` in ``native/staging.cpp``, sharded across a small
+  ``std::thread`` pool with the GIL released for the whole batch. The
+  encoder reads payload bytes straight out of the CPython ``bytes``
+  objects through a numpy object array (no join, no pointer-array
+  marshalling — the two measured Python-side costs); the decoder emits a
+  pickle protocol-3 item stream so ONE ``pickle.loads`` materializes all
+  payload objects at C speed instead of a GIL-bound per-row slice loop.
+  Both CPython-layout offsets are computed here and canary-verified
+  against a live bytes object before the path is ever enabled
+  (:func:`_layout_ok`), and dispatch additionally requires a
+  little-endian host (``sr_codec_abi``) where host-order words ARE the
+  ``<u4`` wire format. Gated by ``ShuffleConf.serde_native`` /
+  ``serde_threads``.
+- **numpy fallback** (rounds 1-5): always present, no toolchain needed,
+  explicit ``<u4`` views so even big-endian hosts emit the wire format.
+
+Both paths feed the process-wide metrics registry
+(``serde.encode_bytes`` / ``serde.decode_bytes`` / ``…_ns`` counters);
+the SPI layer folds the cumulative totals into each exchange span so
+``shuffle_report.py`` can say whether a byte-payload job is codec-bound.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+# CPython bytes-object layout, used by the native encoder: ob_size lives
+# at PyVarObject offset 16 (refcount + type pointer on 64-bit), payload
+# bytes at __basicsize__ - 1 (basicsize counts the trailing NUL). Both
+# are verified by _layout_ok() against a live object before use.
+_SIZE_OFF = 16
+_DATA_OFF = bytes.__basicsize__ - 1
+_PICKLE_HEAD = b"\x80\x03("   # PROTO 3, MARK
+_PICKLE_TAIL = b"l."          # LIST, STOP
+
+_layout_checked: Optional[bool] = None
 
 
 def payload_words(max_payload_bytes: int) -> int:
@@ -41,71 +79,296 @@ def payload_words(max_payload_bytes: int) -> int:
     return 1 + (max_payload_bytes + 3) // 4
 
 
+def _layout_ok() -> bool:
+    """Canary: probe a known bytes object through the exact offsets the
+    native encoder will use; any CPython whose layout differs fails the
+    probe and keeps the numpy path. Cached per process."""
+    global _layout_checked
+    if _layout_checked is None:
+        import ctypes
+        try:
+            if ctypes.sizeof(ctypes.c_void_p) != 8:
+                raise OverflowError("32-bit pointers")
+            probe = b"sparkrdma codec layout probe"
+            holder = np.empty(1, dtype=object)
+            holder[0] = probe
+            op = ctypes.cast(holder.ctypes.data,
+                             ctypes.POINTER(ctypes.c_void_p))[0]
+            tp = ctypes.cast(op + 8, ctypes.POINTER(ctypes.c_void_p))[0]
+            sz = ctypes.cast(op + _SIZE_OFF,
+                             ctypes.POINTER(ctypes.c_int64))[0]
+            data = ctypes.string_at(op + _DATA_OFF, len(probe))
+            _layout_checked = (tp == id(bytes) and sz == len(probe)
+                               and data == probe)
+        except Exception:
+            _layout_checked = False
+    return _layout_checked
+
+
+def native_codec_available() -> bool:
+    """True when encode/decode can dispatch to the native codec."""
+    from sparkrdma_tpu.hbm.host_staging import codec_available
+
+    return codec_available() and _layout_ok()
+
+
+def _auto_threads(threads: Optional[int]) -> int:
+    """Resolve a thread-count knob: None/0 = auto (bounded small pool)."""
+    if threads:
+        return int(threads)
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def _coerce_payloads(payloads: Sequence[bytes]) -> List[bytes]:
+    """Normalize payloads to a list of bytes.
+
+    Accepts bytes plus any buffer-protocol object (bytearray,
+    memoryview, numpy uint8 arrays — Spark's serializers hand over
+    ByteBuffer views the same way). Anything else — notably str (encode
+    it yourself; the codec won't guess an encoding) and int (``bytes(5)``
+    would silently mean five NUL bytes) — raises a ValueError naming the
+    offending row.
+    """
+    out: List[bytes] = []
+    for i, p in enumerate(payloads):
+        if type(p) is bytes:
+            out.append(p)
+        elif isinstance(p, (bytes, bytearray, memoryview)):
+            out.append(bytes(p))
+        elif isinstance(p, (str, int)):
+            raise ValueError(
+                f"payload {i} is {type(p).__name__}, not bytes-like "
+                "(encode strings explicitly; the codec will not guess)")
+        else:
+            try:
+                out.append(bytes(memoryview(p)))
+            except TypeError:
+                raise ValueError(
+                    f"payload {i} is {type(p).__name__}, which does not "
+                    "support the buffer protocol — pass bytes, "
+                    "bytearray, memoryview, or a uint8 array") from None
+    return out
+
+
+def _count(op: str, nbytes: int, ns: int, native: bool) -> None:
+    """Fold one codec call into the process-wide registry (the
+    ``_count_spill`` pattern: serde runs with no manager in reach, so
+    totals accumulate globally and the SPI layer folds the cumulative
+    values into each exchange span at emit time)."""
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    reg = global_registry()
+    reg.counter(f"serde.{op}_bytes").inc(nbytes)
+    reg.counter(f"serde.{op}_ns").inc(ns)
+    reg.counter(f"serde.{op}_calls").inc()
+    reg.counter(f"serde.{op}_native" if native
+                else f"serde.{op}_fallback").inc()
+
+
+def codec_totals() -> dict:
+    """Cumulative process-wide codec totals (journal field source).
+
+    Byte counts are ENCODED bytes (the wire format — same accounting as
+    the fabric GB/s), seconds are host wall-clock inside the codec."""
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    reg = global_registry()
+
+    def _c(name: str) -> int:
+        return int(reg.counter(name).value)
+
+    return {
+        "serde_encode_bytes": _c("serde.encode_bytes"),
+        "serde_encode_s": _c("serde.encode_ns") / 1e9,
+        "serde_decode_bytes": _c("serde.decode_bytes"),
+        "serde_decode_s": _c("serde.decode_ns") / 1e9,
+    }
+
+
+def _oversize_error(lens: np.ndarray, max_payload_bytes: int) -> ValueError:
+    i = int(np.argmax(lens > max_payload_bytes))
+    return ValueError(
+        f"payload {i} is {int(lens[i])} bytes > max_payload_bytes "
+        f"{max_payload_bytes} (raise the bound or split the "
+        "payload — the serializer will not truncate silently)")
+
+
 def encode_bytes_rows(
-    keys: np.ndarray, payloads: Sequence[bytes], max_payload_bytes: int
+    keys: np.ndarray,
+    payloads: Sequence[bytes],
+    max_payload_bytes: int,
+    *,
+    native: Optional[bool] = None,
+    threads: Optional[int] = None,
+    out: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Encode ``(key words, bytes payload)`` pairs into record rows.
 
     ``keys: uint32[N, key_words]``; returns ``uint32[N, key_words + 1 +
     ceil(max_payload_bytes/4)]`` rows ready for
     ``MeshRuntime.shard_records`` / ``Dataset.from_host_rows``.
+
+    ``native=None`` auto-dispatches to the C++ codec when available
+    (``False`` forces the numpy fallback — bit-identical output);
+    ``threads`` sizes the native pool (0/None = auto). ``out`` lets the
+    pipelined write path encode into a pooled buffer instead of
+    allocating (must be C-contiguous uint32 of the output shape).
     """
-    keys = np.asarray(keys, dtype=np.uint32)
+    t0 = time.perf_counter_ns()
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
     n, kw = keys.shape
     if len(payloads) != n:
         raise ValueError(f"{n} keys but {len(payloads)} payloads")
     slot_words = payload_words(max_payload_bytes) - 1
-    out = np.zeros((n, kw + 1 + slot_words), dtype=np.uint32)
-    out[:, :kw] = keys
-    # bulk encode (round 5 — the per-row frombuffer loop measured ~30x
-    # slower at bench scale): lengths in one fromiter pass, then ONE
-    # join of zero-ljust'ed payloads gives the padded byte layout
-    # directly (ljust is a single C call per row; measured 0.3s/1M
-    # records vs 5.6s for cumsum+repeat scatter indexing and 10s for
-    # the old per-row loop)
-    lens = np.fromiter((len(p) for p in payloads), dtype=np.int64,
-                       count=n) if n else np.zeros(0, np.int64)
-    if n and int(lens.max(initial=0)) > max_payload_bytes:
-        i = int(np.argmax(lens > max_payload_bytes))
-        raise ValueError(
-            f"payload {i} is {int(lens[i])} bytes > max_payload_bytes "
-            f"{max_payload_bytes} (raise the bound or split the "
-            "payload — the serializer will not truncate silently)")
-    out[:, kw] = lens.astype(np.uint32)
-    if slot_words and n:
-        slot_bytes = slot_words * 4
-        buf = np.frombuffer(
-            b"".join(p.ljust(slot_bytes, b"\0") for p in payloads),
-            dtype=np.uint8)
-        out[:, kw + 1:] = buf.view("<u4").reshape(n, slot_words)
+    w = kw + 1 + slot_words
+    if out is None:
+        out = np.empty((n, w), dtype=np.uint32)
+    elif (out.shape != (n, w) or out.dtype != np.uint32
+          or not out.flags.c_contiguous):
+        raise ValueError(f"out must be C-contiguous uint32[{n}, {w}]")
+    use_native = native is not False and n > 0 and native_codec_available()
+    if use_native:
+        from sparkrdma_tpu.hbm.host_staging import load_native
+
+        lib = load_native()
+        # a numpy object array's storage is a contiguous PyObject*
+        # vector: the C threads read each bytes object's size and bytes
+        # directly (offsets canary-verified in _layout_ok), so the only
+        # Python-side cost is this C-speed element copy
+        objs = np.empty(n, dtype=object)
+        coerced = False
+        try:
+            objs[:] = payloads
+        except ValueError:
+            # e.g. a list of equal-length uint8 arrays, which numpy
+            # would try to broadcast as a 2-D block
+            payloads = _coerce_payloads(payloads)
+            coerced = True
+            objs[:] = payloads
+
+        def _call() -> int:
+            return int(lib.sr_encode_rows(
+                objs.ctypes.data, id(bytes), _SIZE_OFF, _DATA_OFF,
+                keys.ctypes.data, n, kw, slot_words, max_payload_bytes,
+                out.ctypes.data, _auto_threads(threads)))
+
+        rc = _call()
+        if rc < 0 and not coerced:
+            # a non-bytes payload (or an oversize one) — normalize,
+            # which raises the precise error for non-buffer rows, then
+            # retry once
+            payloads = _coerce_payloads(payloads)
+            objs[:] = payloads
+            rc = _call()
+        if rc < 0:
+            # all payloads are bytes now, so the only legal failure is
+            # an oversize payload; raise the shared error message
+            lens = np.fromiter(map(len, payloads), np.int64, count=n)
+            if int(lens.max(initial=0)) > max_payload_bytes:
+                raise _oversize_error(lens, max_payload_bytes)
+            raise RuntimeError(
+                f"native encoder rejected row {-rc - 1} after coercion "
+                "— codec inconsistency")
+    else:
+        if set(map(type, payloads)) - {bytes}:
+            payloads = _coerce_payloads(payloads)
+        # bulk numpy encode (round 5 — the per-row frombuffer loop
+        # measured ~30x slower at bench scale): lengths in one fromiter
+        # pass, then ONE join of zero-ljust'ed payloads gives the padded
+        # byte layout directly
+        lens = np.fromiter(map(len, payloads), dtype=np.int64,
+                           count=n) if n else np.zeros(0, np.int64)
+        if n and int(lens.max(initial=0)) > max_payload_bytes:
+            raise _oversize_error(lens, max_payload_bytes)
+        out[:, :kw] = keys
+        out[:, kw] = lens.astype(np.uint32)
+        if slot_words and n:
+            slot_bytes = slot_words * 4
+            buf = np.frombuffer(
+                b"".join(p.ljust(slot_bytes, b"\0") for p in payloads),
+                dtype=np.uint8)
+            out[:, kw + 1:] = buf.view("<u4").reshape(n, slot_words)
+    _count("encode", out.nbytes, time.perf_counter_ns() - t0, use_native)
     return out
 
 
 def decode_bytes_rows(
-    rows: np.ndarray, key_words: int
+    rows: np.ndarray,
+    key_words: int,
+    *,
+    native: Optional[bool] = None,
+    threads: Optional[int] = None,
 ) -> Tuple[np.ndarray, List[bytes]]:
     """Inverse of :func:`encode_bytes_rows` for any row batch (e.g. the
-    valid rows of an exchange output): returns ``(keys, payloads)``."""
+    valid rows of an exchange output): returns ``(keys, payloads)``.
+
+    ``native`` / ``threads`` as in :func:`encode_bytes_rows`; both
+    implementations return identical values and raise the same
+    corrupt-length ValueError on the same (smallest) offending row.
+    """
+    t0 = time.perf_counter_ns()
     rows = np.asarray(rows, dtype=np.uint32)
     n, w = rows.shape
-    keys = rows[:, :key_words]
-    lens = rows[:, key_words]
     slot_words = w - key_words - 1
     max_bytes = slot_words * 4
-    if n and int(lens.max(initial=0)) > max_bytes:
-        i = int(np.argmax(lens > max_bytes))
-        raise ValueError(
-            f"row {i} declares {int(lens[i])} payload bytes but the "
-            f"slot holds {max_bytes} — corrupt length word")
-    # bulk decode: ONE contiguous-bytes materialization of the whole
-    # blob, then per-row slicing of a Python bytes object (C-speed, no
-    # per-row numpy ops — round 5, same rationale as the encoder)
-    whole = np.ascontiguousarray(
-        rows[:, key_words + 1:].astype("<u4")).view(np.uint8).tobytes()
-    lens_l = lens.tolist()
-    payloads = [whole[i * max_bytes: i * max_bytes + ln]
-                for i, ln in enumerate(lens_l)]
+    use_native = (native is not False and n > 0 and slot_words > 0
+                  and native_codec_available())
+    if use_native:
+        import pickle
+
+        from sparkrdma_tpu.hbm.host_staging import load_native
+
+        lib = load_native()
+        crows = np.ascontiguousarray(rows)
+        keys = np.empty((n, key_words), dtype=np.uint32)
+        # plan pass: one serial C sweep validates every length word and
+        # lays out the pickle-item stream (per-row offsets + total size)
+        soff = np.empty(n, dtype=np.int64)
+        total = int(lib.sr_decode_plan(
+            crows.ctypes.data, n, key_words, slot_words,
+            len(_PICKLE_HEAD), soff.ctypes.data))
+        if total < 0:
+            i = -total - 1
+            raise ValueError(
+                f"row {i} declares {int(crows[i, key_words])} payload "
+                f"bytes but the slot holds {max_bytes} — corrupt length "
+                "word")
+        # scatter pass: the C threads write each payload as a pickle
+        # protocol-3 item (SHORT_BINBYTES/BINBYTES — frozen format) at
+        # soff[i]; one loads() call then builds all n bytes objects
+        # inside the C unpickler, ~2x faster than a GIL-bound per-row
+        # slice loop
+        buf = np.empty(len(_PICKLE_HEAD) + total + len(_PICKLE_TAIL),
+                       dtype=np.uint8)
+        buf[:len(_PICKLE_HEAD)] = np.frombuffer(_PICKLE_HEAD, np.uint8)
+        buf[len(_PICKLE_HEAD) + total:] = np.frombuffer(_PICKLE_TAIL,
+                                                        np.uint8)
+        rc = int(lib.sr_decode_rows(
+            crows.ctypes.data, n, key_words, slot_words, keys.ctypes.data,
+            soff.ctypes.data, buf.ctypes.data, _auto_threads(threads)))
+        if rc < 0:  # unreachable after the plan validation; defensive
+            raise ValueError(f"row {-rc - 1} rejected by native decoder "
+                             "— corrupt length word")
+        payloads = pickle.loads(memoryview(buf))
+    else:
+        lens = rows[:, key_words]
+        if n and int(lens.max(initial=0)) > max_bytes:
+            i = int(np.argmax(lens > max_bytes))
+            raise ValueError(
+                f"row {i} declares {int(lens[i])} payload bytes but the "
+                f"slot holds {max_bytes} — corrupt length word")
+        keys = rows[:, :key_words]
+        # bulk decode: ONE contiguous-bytes materialization of the whole
+        # blob, then per-row slicing of a Python bytes object (C-speed,
+        # no per-row numpy ops — round 5, same rationale as the encoder)
+        whole = np.ascontiguousarray(
+            rows[:, key_words + 1:].astype("<u4")).view(np.uint8).tobytes()
+        lens_l = lens.tolist()
+        payloads = [whole[i * max_bytes: i * max_bytes + ln]
+                    for i, ln in enumerate(lens_l)]
+    _count("decode", rows.nbytes, time.perf_counter_ns() - t0, use_native)
     return keys, payloads
 
 
-__all__ = ["encode_bytes_rows", "decode_bytes_rows", "payload_words"]
+__all__ = ["encode_bytes_rows", "decode_bytes_rows", "payload_words",
+           "native_codec_available", "codec_totals"]

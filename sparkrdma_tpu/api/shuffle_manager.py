@@ -339,8 +339,10 @@ class ShuffleReader:
                 per_source_records=per_source,
             ))
             if journal_on:
+                from sparkrdma_tpu.api.serde import codec_totals
                 from sparkrdma_tpu.hbm.host_staging import spill_count
 
+                serde = codec_totals()
                 pool = self._m.runtime.pool
                 span = ExchangeSpan(
                     span_id=span_id,
@@ -361,6 +363,10 @@ class ShuffleReader:
                                      if pool is not None else 0),
                     spill_count=spill_count(),
                     retry_count=attempt - 1,
+                    serde_encode_bytes=serde["serde_encode_bytes"],
+                    serde_encode_s=serde["serde_encode_s"],
+                    serde_decode_bytes=serde["serde_decode_bytes"],
+                    serde_decode_s=serde["serde_decode_s"],
                     process_index=self._m.runtime.process_index,
                     host_count=self._m.runtime.process_count,
                     # drain restarts the timeline clock, so the next

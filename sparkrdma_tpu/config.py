@@ -243,6 +243,23 @@ class ShuffleConf:
     compression: str = ""
     compression_level: int = 1        # zlib 1-9 / lzma preset 0-9
 
+    # --- byte-payload serde (api/serde.py, api/pipeline.py) ---
+    #: dispatch encode/decode to the multi-threaded C++ codec in
+    #: native/staging.cpp when it is available (built on demand, GIL
+    #: released for the whole batch; little-endian hosts only). False
+    #: forces the numpy fallback — bit-identical rows either way, the
+    #: knob only trades speed.
+    serde_native: bool = True
+    #: std::thread pool size for one native codec call. 0 (default) =
+    #: auto (min(8, cpu count)).
+    serde_threads: int = 0
+    #: pipelined byte-payload chunk size, in records: from_host_payloads
+    #: / to_host_payloads split batches into chunks of this many records
+    #: so host encode of chunk k+1 overlaps device transfer of chunk k
+    #: (double-buffered through the host staging pool). 0 disables
+    #: chunking (one-shot encode, no overlap).
+    serde_chunk_records: int = 1 << 20
+
     def __post_init__(self) -> None:
         if self.slot_records <= 0:
             raise ValueError("slot_records must be positive")
@@ -283,6 +300,11 @@ class ShuffleConf:
         if self.journal_max_bytes < 0:
             raise ValueError("journal_max_bytes must be >= 0 (0 = no "
                              "rotation)")
+        if self.serde_threads < 0:
+            raise ValueError("serde_threads must be >= 0 (0 = auto)")
+        if self.serde_chunk_records < 0:
+            raise ValueError("serde_chunk_records must be >= 0 (0 = no "
+                             "chunking)")
         self.sampling_policy()  # validate journal_sample eagerly
         _parse_prealloc(self.prealloc)  # validate eagerly
 

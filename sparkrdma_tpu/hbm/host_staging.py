@@ -109,14 +109,24 @@ def decompress_blob(blob: bytes) -> bytes:
     if magic != _CODEC_MAGIC:
         raise OSError("not a compressed spill blob (bad magic)")
     body = blob[_HDR.size:]
+    # truncated/flipped compressed bytes surface as codec-specific
+    # exceptions (zlib.error, lzma.LZMAError); re-raise as OSError so
+    # callers see read_array's documented corruption contract instead of
+    # needing to know which codec wrote the file
     if cid == _CODEC_IDS["zlib"]:
         import zlib
 
-        raw = zlib.decompress(body)
+        try:
+            raw = zlib.decompress(body)
+        except zlib.error as e:
+            raise OSError(f"corrupt spill blob: {e}") from e
     elif cid == _CODEC_IDS["lzma"]:
         import lzma
 
-        raw = lzma.decompress(body)
+        try:
+            raw = lzma.decompress(body)
+        except lzma.LZMAError as e:
+            raise OSError(f"corrupt spill blob: {e}") from e
     else:
         raise OSError(f"unknown codec id {cid} in spill header")
     if len(raw) != raw_n:
@@ -164,6 +174,52 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sr_spooler_drain.restype = ctypes.c_long
     lib.sr_spooler_drain.argtypes = [ctypes.c_void_p]
     lib.sr_spooler_destroy.argtypes = [ctypes.c_void_p]
+    # serde codec entry points are newer than the pool/spool ABI: a
+    # prebuilt library from an older source tree may lack them. Staging
+    # still works without them — the serde layer just keeps its numpy
+    # path (sr_has_codec gates dispatch). sr_codec_abi() returns 1 only
+    # on little-endian hosts, where native rows match the '<u4' wire
+    # format byte-for-byte.
+    try:
+        lib.sr_codec_abi.restype = ctypes.c_int
+        lib.sr_codec_abi.argtypes = []
+        lib.sr_encode_rows.restype = ctypes.c_long
+        lib.sr_encode_rows.argtypes = [
+            ctypes.c_void_p,   # objs  PyObject*[n] (numpy object array)
+            ctypes.c_void_p,   # bytes_type  id(bytes)
+            ctypes.c_int64,    # size_off  ob_size offset in bytes objects
+            ctypes.c_int64,    # data_off  payload offset in bytes objects
+            ctypes.c_void_p,   # keys  uint32[n * key_words]
+            ctypes.c_int64,    # n
+            ctypes.c_int64,    # key_words
+            ctypes.c_int64,    # slot_words
+            ctypes.c_int64,    # max_payload_bytes
+            ctypes.c_void_p,   # out   uint32[n * row_words]
+            ctypes.c_int64,    # threads
+        ]
+        lib.sr_decode_plan.restype = ctypes.c_long
+        lib.sr_decode_plan.argtypes = [
+            ctypes.c_void_p,   # rows  uint32[n * row_words]
+            ctypes.c_int64,    # n
+            ctypes.c_int64,    # key_words
+            ctypes.c_int64,    # slot_words
+            ctypes.c_int64,    # base  stream offset of the first item
+            ctypes.c_void_p,   # soff  int64[n] out
+        ]
+        lib.sr_decode_rows.restype = ctypes.c_long
+        lib.sr_decode_rows.argtypes = [
+            ctypes.c_void_p,   # rows  uint32[n * row_words]
+            ctypes.c_int64,    # n
+            ctypes.c_int64,    # key_words
+            ctypes.c_int64,    # slot_words
+            ctypes.c_void_p,   # keys_out uint32[n * key_words]
+            ctypes.c_void_p,   # soff  int64[n] pickle-stream row offsets
+            ctypes.c_void_p,   # stream_out uint8[] pickle item stream
+            ctypes.c_int64,    # threads
+        ]
+        lib.sr_has_codec = bool(lib.sr_codec_abi())
+    except AttributeError:
+        lib.sr_has_codec = False
     return lib
 
 
@@ -175,11 +231,20 @@ def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
             return _lib
         _lib_attempted = True
         try:
-            if not _LIB_PATH.exists() and build_if_missing:
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True, capture_output=True, timeout=120,
-                )
+            if build_if_missing:
+                # make is incremental: a no-op when the library is
+                # current, a rebuild when staging.cpp grew entry points
+                # since the .so was produced (the serde codec did exactly
+                # that). A failed make — no toolchain — still falls
+                # through to loading whatever prebuilt library exists.
+                try:
+                    subprocess.run(
+                        ["make", "-C", str(_NATIVE_DIR)],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                except (OSError, subprocess.SubprocessError):
+                    if not _LIB_PATH.exists():
+                        raise
             if _LIB_PATH.exists():
                 _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
                 log.info("native staging library loaded: %s", _LIB_PATH)
@@ -187,6 +252,13 @@ def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
             log.warning("native staging unavailable (%s); numpy fallback", e)
             _lib = None
         return _lib
+
+
+def codec_available() -> bool:
+    """True when the native serde codec can be dispatched: library
+    loaded, codec entry points present, little-endian host."""
+    lib = load_native()
+    return lib is not None and bool(getattr(lib, "sr_has_codec", False))
 
 
 class HostBuffer:
@@ -448,5 +520,5 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
 
 
 __all__ = ["HostBufferPool", "HostBuffer", "SpillWriter", "write_array",
-           "read_array", "load_native", "compress_array",
-           "decompress_blob", "spill_count"]
+           "read_array", "load_native", "codec_available",
+           "compress_array", "decompress_blob", "spill_count"]

@@ -85,7 +85,9 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: v2: + ``events`` timeline, + ``process_index``/``host_count`` identity.
 #: v3: + ``sample_weight`` (span sampling), + auxiliary ``rollup`` and
 #: ``heartbeat`` line kinds (see obs/rollup.py).
-SCHEMA_VERSION = 3
+#: v4: + ``serde_encode_bytes``/``serde_encode_s`` and decode twins —
+#: process-cumulative host codec totals (api/serde.py), spill_count-style.
+SCHEMA_VERSION = 4
 
 
 @dataclasses.dataclass
@@ -117,6 +119,12 @@ class ExchangeSpan:
     events: List[Dict] = dataclasses.field(default_factory=list)
     # --- sampling (schema v3): reads this span stands for (>=1) ---
     sample_weight: int = 1
+    # --- host serde codec totals (schema v4) — PROCESS-CUMULATIVE like
+    # ``spill_count``: consumers diff consecutive spans for rates ---
+    serde_encode_bytes: int = 0
+    serde_encode_s: float = 0.0
+    serde_decode_bytes: int = 0
+    serde_decode_s: float = 0.0
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

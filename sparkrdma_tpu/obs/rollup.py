@@ -54,6 +54,8 @@ ROLLUP_FIELDS = frozenset({
     "window_start", "window_s",
     "reads", "sampled_reads", "records", "bytes", "rounds", "dispatches",
     "retries", "spills", "streaming_reads", "fused_reads",
+    "serde_encode_bytes", "serde_encode_mbps",
+    "serde_decode_bytes", "serde_decode_mbps",
     "lat_bounds_ms", "lat_buckets", "lat_sum_ms", "lat_max_ms",
     "p50_ms", "p95_ms", "p99_ms",
 })
@@ -79,7 +81,9 @@ class _Cell:
 
     __slots__ = ("reads", "sampled_reads", "records", "bytes", "rounds",
                  "dispatches", "retries", "spills", "streaming_reads",
-                 "fused_reads", "lat_buckets", "lat_sum_ms", "lat_max_ms")
+                 "fused_reads", "serde_encode_bytes", "serde_encode_s",
+                 "serde_decode_bytes", "serde_decode_s",
+                 "lat_buckets", "lat_sum_ms", "lat_max_ms")
 
     def __init__(self):
         self.reads = 0
@@ -92,6 +96,10 @@ class _Cell:
         self.spills = 0
         self.streaming_reads = 0
         self.fused_reads = 0
+        self.serde_encode_bytes = 0
+        self.serde_encode_s = 0.0
+        self.serde_decode_bytes = 0
+        self.serde_decode_s = 0.0
         self.lat_buckets = [0] * (len(LATENCY_BOUNDS_MS) + 1)
         self.lat_sum_ms = 0.0
         self.lat_max_ms = 0.0
@@ -122,6 +130,9 @@ class RollupAggregator:
         self._window_start: Optional[float] = None
         self._cells: Dict[int, _Cell] = {}
         self._last_spill = 0          # spill_count is process-cumulative
+        # serde codec totals are process-cumulative too (schema v4);
+        # windows carry the delta, same trick as spills
+        self._last_serde = (0, 0.0, 0, 0.0)
         #: rollup lines emitted over this aggregator's lifetime
         self.emitted = 0
 
@@ -150,6 +161,15 @@ class RollupAggregator:
             if spill_delta > 0:
                 cell.spills += spill_delta
                 self._last_spill = span.spill_count
+            cur = (span.serde_encode_bytes, span.serde_encode_s,
+                   span.serde_decode_bytes, span.serde_decode_s)
+            if cur > self._last_serde:
+                last = self._last_serde
+                cell.serde_encode_bytes += cur[0] - last[0]
+                cell.serde_encode_s += cur[1] - last[1]
+                cell.serde_decode_bytes += cur[2] - last[2]
+                cell.serde_decode_s += cur[3] - last[3]
+                self._last_serde = cur
             if span.dispatches > 1:
                 cell.streaming_reads += 1
             else:
@@ -195,6 +215,14 @@ class RollupAggregator:
                 "spills": c.spills,
                 "streaming_reads": c.streaming_reads,
                 "fused_reads": c.fused_reads,
+                "serde_encode_bytes": c.serde_encode_bytes,
+                "serde_encode_mbps": round(
+                    c.serde_encode_bytes / c.serde_encode_s / 1e6, 3)
+                if c.serde_encode_s > 0 else 0.0,
+                "serde_decode_bytes": c.serde_decode_bytes,
+                "serde_decode_mbps": round(
+                    c.serde_decode_bytes / c.serde_decode_s / 1e6, 3)
+                if c.serde_decode_s > 0 else 0.0,
                 "lat_bounds_ms": list(LATENCY_BOUNDS_MS),
                 "lat_buckets": list(c.lat_buckets),
                 "lat_sum_ms": round(c.lat_sum_ms, 3),

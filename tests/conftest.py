@@ -43,3 +43,17 @@ def runtime():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def native_codec():
+    """Build (incremental ``make``) and load the native staging library
+    with the serde codec entry points; tests needing the native path
+    depend on this fixture and skip cleanly on hosts without a C++
+    toolchain, keeping tier-1 green everywhere."""
+    from sparkrdma_tpu.api.serde import native_codec_available
+
+    if not native_codec_available():
+        pytest.skip("native serde codec unavailable "
+                    "(no C++ toolchain or unsupported object layout)")
+    return True

@@ -1,0 +1,151 @@
+"""Pipelined byte-payload load/unload path (api/pipeline.py).
+
+The contract under test: chunked, overlapped encode->H2D produces a
+BIT-IDENTICAL device layout to the single-shot ``encode_bytes_rows ->
+shard_records`` path (overlap is an implementation detail, never a
+placement change), and the decode side's D2H-prefetch walk returns
+exactly what the plain host path would.
+"""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import ShuffleConf
+from sparkrdma_tpu.api.dataset import Dataset
+from sparkrdma_tpu.api.serde import encode_bytes_rows, payload_words
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+MAXB = 13
+KW = 2
+VW = payload_words(MAXB)
+
+
+@pytest.fixture
+def manager():
+    conf = ShuffleConf(slot_records=256, key_words=KW, val_words=VW,
+                       serde_chunk_records=64)
+    m = ShuffleManager(conf=conf)
+    yield m
+    m.stop()
+
+
+def _corpus(rng, n):
+    keys = rng.integers(0, 1 << 20, size=(n, KW), dtype=np.uint32)
+    payloads = [rng.bytes(int(k))
+                for k in rng.integers(0, MAXB + 1, size=n)]
+    return keys, payloads
+
+
+class TestOverlapEquivalence:
+    def test_overlap_on_off_and_single_shot_identical(self, manager, rng):
+        n = 1024                       # 16 chunks of 64 over 8 devices
+        keys, payloads = _corpus(rng, n)
+        ds_ov = Dataset.from_host_payloads(manager, keys, payloads, MAXB,
+                                           overlap=True)
+        ds_seq = Dataset.from_host_payloads(manager, keys, payloads, MAXB,
+                                            overlap=False)
+        ds_one = Dataset.from_host_payloads(manager, keys, payloads, MAXB,
+                                            chunk_records=0)
+        ref = manager.runtime.shard_records(
+            encode_bytes_rows(keys, payloads, MAXB))
+        a = np.asarray(ds_ov.records)
+        np.testing.assert_array_equal(a, np.asarray(ds_seq.records))
+        np.testing.assert_array_equal(a, np.asarray(ds_one.records))
+        np.testing.assert_array_equal(a, np.asarray(ref))
+        # placement, not just values: every per-device shard matches
+        for got, want in zip(ds_ov.records.addressable_shards,
+                             ref.addressable_shards):
+            assert got.device == want.device
+            np.testing.assert_array_equal(np.asarray(got.data),
+                                          np.asarray(want.data))
+
+    def test_ragged_last_chunk(self, manager, rng):
+        # 1000/8 = 125 rows per device; chunk 64/8 = 8 -> last chunk 5
+        keys, payloads = _corpus(rng, 1000)
+        ds = Dataset.from_host_payloads(manager, keys, payloads, MAXB)
+        ref = manager.runtime.shard_records(
+            encode_bytes_rows(keys, payloads, MAXB))
+        np.testing.assert_array_equal(np.asarray(ds.records),
+                                      np.asarray(ref))
+
+    def test_decode_overlap_equivalence(self, manager, rng):
+        keys, payloads = _corpus(rng, 512)
+        ds = Dataset.from_host_payloads(manager, keys, payloads, MAXB)
+        k1, p1 = ds.to_host_payloads(overlap=True)
+        k2, p2 = ds.to_host_payloads(overlap=False)
+        np.testing.assert_array_equal(k1, keys)
+        assert p1 == payloads
+        np.testing.assert_array_equal(k1, k2)
+        assert p1 == p2
+
+
+class TestPayloadDatasetLifecycle:
+    def test_round_trip_through_shuffle_verb(self, manager, rng):
+        """Payload datasets ride the ordinary exchange verbs: a
+        repartition's output decodes to the same key->payload set."""
+        n = 256
+        keys, payloads = _corpus(rng, n)
+        keys[:, 0] = np.arange(n, dtype=np.uint32)   # unique -> set cmp
+        ds = Dataset.from_host_payloads(manager, keys, payloads, MAXB)
+        out = ds.repartition(8)
+        gk, gp = out.to_host_payloads()
+        ref = {(tuple(int(w) for w in k), p)
+               for k, p in zip(keys, payloads)}
+        assert {(tuple(int(w) for w in k), p)
+                for k, p in zip(gk, gp)} == ref
+
+    def test_empty_batch(self, manager):
+        ds = Dataset.from_host_payloads(
+            manager, np.empty((0, KW), np.uint32), [], MAXB)
+        k, p = ds.to_host_payloads()
+        assert k.shape == (0, KW) and p == []
+
+    def test_val_words_mismatch_rejected(self, manager):
+        with pytest.raises(ValueError, match="val_words"):
+            Dataset.from_host_payloads(
+                manager, np.zeros((8, KW), np.uint32), [b""] * 8,
+                MAXB + 64)
+
+    def test_reserved_key_rejected(self, manager):
+        keys = np.zeros((8, KW), np.uint32)
+        keys[3] = 0xFFFFFFFF
+        with pytest.raises(ValueError, match="reserved"):
+            Dataset.from_host_payloads(manager, keys, [b""] * 8, MAXB)
+
+    def test_filler_rows_dropped_on_decode(self, manager, rng):
+        """A padded Dataset (filler rows carrying the reserved null key)
+        decodes to only the real payloads — the same filler contract
+        ``to_host_rows`` honors."""
+        keys, payloads = _corpus(rng, 64)
+        rows = encode_bytes_rows(keys, payloads, MAXB)
+        filler = np.full((8, rows.shape[1]), 0xFFFFFFFF, np.uint32)
+        padded = np.concatenate([rows[:32], filler[:4],
+                                 rows[32:], filler[4:]])
+        ds = Dataset(manager, manager.runtime.shard_records(padded))
+        k, p = ds.to_host_payloads()
+        assert len(p) == 64
+        got = {(tuple(int(w) for w in kk), pp) for kk, pp in zip(k, p)}
+        want = {(tuple(int(w) for w in kk), pp)
+                for kk, pp in zip(keys, payloads)}
+        assert got == want
+
+    def test_stage_events_on_timeline(self, tmp_path, rng):
+        """Pipeline stage occupancy lands on the manager's timeline as
+        B/E pairs — the journal's next span will carry them. (The
+        timeline only records when the journal is on, so this manager
+        gets a sink.)"""
+        conf = ShuffleConf(slot_records=256, key_words=KW, val_words=VW,
+                           serde_chunk_records=64,
+                           metrics_sink=str(tmp_path / "j.jsonl"))
+        m = ShuffleManager(conf=conf)
+        try:
+            keys, payloads = _corpus(rng, 512)
+            m.timeline.reset()
+            ds = Dataset.from_host_payloads(m, keys, payloads, MAXB)
+            ds.to_host_payloads()
+            names = {(e["name"], e["ph"]) for e in m.timeline.drain()}
+            for stage in ("serde:encode", "serde:h2d",
+                          "serde:d2h", "serde:decode"):
+                assert (stage, "B") in names and (stage, "E") in names
+        finally:
+            m.stop()

@@ -348,13 +348,17 @@ class TestSchemaV2V3:
         assert span.span_id == 9 and span.schema == 2
 
     def test_v3_line_readable_by_v2_reader(self):
-        """Emulate the v2 drop-unknown-keys reader over a v3 line: every
-        v2 field must survive (no rename/removal), and the v3-only
-        extras must be exactly the droppable set."""
+        """Emulate the v2 drop-unknown-keys reader over a current line:
+        every v2 field must survive (no rename/removal), and the
+        newer-schema extras must be exactly the droppable set."""
         d = make_span(sample_weight=8).to_dict()
         missing = [f for f in V2_FIELDS if f not in d]
-        assert not missing, f"v3 line lost v2 fields: {missing}"
-        assert set(d) - set(V2_FIELDS) == {"sample_weight"}
+        assert not missing, f"newer line lost v2 fields: {missing}"
+        assert set(d) - set(V2_FIELDS) == {
+            "sample_weight",                   # v3: span sampling
+            "serde_encode_bytes", "serde_encode_s",   # v4: host codec
+            "serde_decode_bytes", "serde_decode_s",
+        }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)
         assert span.records == d["records"]

@@ -41,6 +41,81 @@ def test_corrupt_length_rejected(rng):
         decode_bytes_rows(rows, 2)
 
 
+def test_buffer_protocol_payloads_accepted(rng):
+    """bytes, bytearray, memoryview and numpy uint8 arrays all encode
+    identically (round-5 advisor finding: the codec must speak the
+    buffer protocol, not just bytes)."""
+    keys = np.zeros((4, 2), np.uint32)
+    mixed = [b"abc", bytearray(b"de"), memoryview(b"fgh"),
+             np.frombuffer(b"ijkl", dtype=np.uint8)]
+    rows = encode_bytes_rows(keys, mixed, max_payload_bytes=8)
+    ref = encode_bytes_rows(keys, [b"abc", b"de", b"fgh", b"ijkl"], 8)
+    np.testing.assert_array_equal(rows, ref)
+    _, got = decode_bytes_rows(rows, 2)
+    assert got == [b"abc", b"de", b"fgh", b"ijkl"]
+
+
+def test_non_buffer_payloads_rejected():
+    """str and int are NOT silently coerced (str has no canonical
+    encoding; bytes(5) would mean five NUL bytes) — clear ValueError."""
+    keys = np.zeros((1, 2), np.uint32)
+    with pytest.raises(ValueError, match="not bytes-like"):
+        encode_bytes_rows(keys, ["text"], 8)
+    with pytest.raises(ValueError, match="not bytes-like"):
+        encode_bytes_rows(keys, [5], 8)
+
+
+class TestNativeNumpyEquivalence:
+    """The fuzz contract of the native codec: bit-identical rows and
+    identical decode output vs the numpy fallback, across thread
+    counts, key widths, slot sizes and degenerate batches."""
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    def test_fuzz_bit_identical_and_lossless(self, native_codec, threads):
+        rng = np.random.default_rng(1000 + threads)
+        for _ in range(6):
+            n = int(rng.integers(1, 400))
+            kw = int(rng.integers(1, 4))
+            maxb = int(rng.integers(1, 97))
+            keys = rng.integers(0, 2**32, size=(n, kw), dtype=np.uint32)
+            payloads = [rng.bytes(int(k))
+                        for k in rng.integers(0, maxb + 1, size=n)]
+            payloads[0] = b""                       # empty payload
+            payloads[-1] = b"\xff" * maxb           # max-length payload
+            nat = encode_bytes_rows(keys, payloads, maxb,
+                                    native=True, threads=threads)
+            ref = encode_bytes_rows(keys, payloads, maxb, native=False)
+            np.testing.assert_array_equal(nat, ref)
+            for native in (True, False):
+                k, p = decode_bytes_rows(nat, kw, native=native,
+                                         threads=threads)
+                np.testing.assert_array_equal(k, keys)
+                assert p == payloads
+
+    def test_zero_row_batch(self, native_codec):
+        keys = np.empty((0, 2), np.uint32)
+        nat = encode_bytes_rows(keys, [], 16, native=True)
+        ref = encode_bytes_rows(keys, [], 16, native=False)
+        np.testing.assert_array_equal(nat, ref)
+        for native in (True, False):
+            k, p = decode_bytes_rows(nat, 2, native=native)
+            assert k.shape == (0, 2) and p == []
+
+    def test_error_paths_agree(self, native_codec):
+        """Oversize payloads and corrupt length words report the same
+        offending row from both codecs."""
+        keys = np.zeros((3, 2), np.uint32)
+        payloads = [b"ok", b"x" * 9, b"y" * 9]      # first bad row: 1
+        for native in (True, False):
+            with pytest.raises(ValueError, match="payload 1 is 9 bytes"):
+                encode_bytes_rows(keys, payloads, 8, native=native)
+        rows = encode_bytes_rows(keys, [b"a", b"bb", b"ccc"], 8)
+        rows[1, 2] = 999
+        for native in (True, False):
+            with pytest.raises(ValueError, match="row 1 declares"):
+                decode_bytes_rows(rows, 2, native=native)
+
+
 def test_encoded_records_shuffle_end_to_end(rng):
     """Encoded byte-payload records ride the ordinary exchange: hash
     repartition + key-sorted read, payloads intact afterwards — the
