@@ -49,6 +49,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 
 def _bench_metrics(manager) -> dict:
@@ -117,6 +118,7 @@ def run_width(record_words: int, records_per_device: int,
                        collect_shuffle_read_stats=True, **kw)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
+        t0 = time.perf_counter()
         res, _, _ = run_terasort(
             manager,
             records_per_device=records_per_device,
@@ -126,7 +128,12 @@ def run_width(record_words: int, records_per_device: int,
             repeats=repeats,
             shuffle_id=0,
         )
+        # whole-leg wall-clock, sample -> plan -> exchange -> sort
+        # (includes warmup/compile, unlike the steady-state gbps number —
+        # the "how long did this leg actually take" answer)
+        e2e_seconds = time.perf_counter() - t0
         metrics = _bench_metrics(manager)
+        metrics["e2e_seconds"] = round(e2e_seconds, 3)
         if not res.verified:
             return -1.0, metrics
         return res.gbps / mesh_size, metrics
@@ -191,8 +198,8 @@ def main(argv=None) -> int:
     if faithful < 0:   # fail fast: don't spend the second leg's minutes
         print(json.dumps({"error": "device verification FAILED"}))
         return 1
-    optimal, _ = run_width(13, records_per_device, repeats,
-                           journal=args.journal)
+    optimal, metrics_opt = run_width(13, records_per_device, repeats,
+                                     journal=args.journal)
     if optimal < 0:
         print(json.dumps({"error": "device verification FAILED"}))
         return 1
@@ -204,6 +211,7 @@ def main(argv=None) -> int:
         "record_bytes": 100,
         "value_width_optimal": round(optimal, 3),
         "width_optimal_record_bytes": 52,
+        "e2e_seconds_width_optimal": metrics_opt["e2e_seconds"],
         "metrics": metrics,   # the faithful (judged) leg's observability
     }))
     return 0

@@ -1,0 +1,282 @@
+#!/usr/bin/env python3
+"""Chaos soak: the real workloads under a randomized multi-site fault
+schedule, proved bit-identical against a fault-free control run.
+
+Two passes over the same seeded data, same mesh, same conf geometry:
+
+1. **Control** — no ``fault_spec``. Captures every leg's output bytes
+   (repartition rows+totals, terasort sorted records, join aggregates,
+   serde round-trip payload map, checkpoint-resume rows).
+2. **Chaos** — the same legs with a ``fault_spec`` injecting transient
+   faults at >= 6 distinct sites (exchange dispatch + streaming rounds,
+   pool acquire delays, spill write/read, checkpoint read, and — when
+   the native codec is built — serde encode). Every fault is transient
+   (``attempt<N``), so recovery MUST reproduce the control outputs
+   bit for bit; any drift is a correctness bug in the recovery paths.
+
+After the chaos pass the soak audits the books: the fault plane's
+``fail``/``corrupt`` injection tally must equal the journal's summed
+``retry_count`` plus the recovery and degradation totals — every
+injected fault is accounted for by exactly one retry, one in-place
+recovery, or one sticky degradation (``delay`` injections only slow
+things down and are excluded). Spans with retries must carry per-attempt
+``backoff_ms`` entries (journal schema v5).
+
+The schedule is *randomized* per ``--seed`` (clause order, delay
+magnitude, data) but fully deterministic given the seed — a failing
+seed replays exactly.
+
+Usage (CPU host, 8 simulated devices)::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py --seed 7
+
+Exit 0: all legs bit-identical, >= 6 sites hit, books balanced.
+Prints one JSON summary line (plus per-leg progress on stderr).
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_spec(rng: random.Random, include_serde: bool) -> str:
+    """Randomized-but-deterministic schedule hitting >= 6 distinct sites.
+
+    Every clause is transient (bounded ``attempt<N``), so the run must
+    converge to the control output; the randomness is in clause order,
+    the injected acquire delay, and (via ``--seed``) the data itself.
+    """
+    clauses = [
+        "exchange.dispatch:fail@attempt<2",
+        "exchange.stream_round:fail@attempt<1",
+        f"pool.acquire:delay={rng.choice((1, 2, 5))}ms@attempt<4",
+        "spill.write:fail@attempt<1",
+        "spill.read:corrupt@attempt<1",
+        "checkpoint.read:fail@attempt<1",
+    ]
+    if include_serde:
+        clauses.append("serde.encode:fail@attempt<1")
+    rng.shuffle(clauses)   # order is cosmetic: sites are distinct
+    return ";".join(clauses)
+
+
+def run_legs(m, seed: int, records_per_device: int) -> dict:
+    """All soak legs on one manager; returns {leg: host-comparable output}.
+
+    Ordering matters only for determinism of fault-hit placement: the
+    repartition leg runs first (absorbing the dispatch/stream-round
+    faults and acquire delays), the resume leg runs last (first
+    ``read_array`` of the run, absorbing the checkpoint-read fail and
+    spill-read corruption inside one bounded ``_checked_read``).
+    """
+    import jax
+    import numpy as np
+
+    from sparkrdma_tpu.api.dataset import Dataset
+    from sparkrdma_tpu.api.serde import (decode_bytes_rows,
+                                         encode_bytes_rows)
+    from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+    from sparkrdma_tpu.workloads.join import run_hash_join
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    rt = m.runtime
+    mesh = rt.num_partitions
+    w = m.conf.record_words
+    rng = np.random.default_rng(seed)
+    out: dict = {}
+
+    def host(a):
+        return np.asarray(jax.device_get(a))
+
+    # --- leg 1: repartition (raw output rows, not just a verified bit) --
+    x = rng.integers(0, 2**32, size=(mesh * records_per_device, w),
+                     dtype=np.uint32)
+    part = hash_partitioner(mesh, m.conf.key_words)
+    h = m.register_shuffle(1, mesh, part)
+    try:
+        m.get_writer(h).write(rt.shard_records(x)).stop(True)
+        rows, totals = m.get_reader(h).read()
+        out["repartition"] = (host(rows).copy(), host(totals).copy())
+    finally:
+        m.unregister_shuffle(1)
+    print("  leg repartition done", file=sys.stderr, flush=True)
+
+    # --- leg 2: terasort (globally sorted records) ----------------------
+    _, srt, stot = run_terasort(m, records_per_device=records_per_device,
+                                seed=seed + 1, shuffle_id=2,
+                                verify=False, warmup=False)
+    out["terasort"] = (host(srt).copy(), host(stot).copy())
+    print("  leg terasort done", file=sys.stderr, flush=True)
+
+    # --- leg 3: hash join (exact aggregate outputs) ---------------------
+    j = run_hash_join(m, rows_per_device_a=records_per_device // 2,
+                      rows_per_device_b=records_per_device // 2,
+                      seed=seed + 2, shuffle_ids=(3, 4), verify=False)
+    out["join"] = (int(j.matches), float(j.sum_products))
+    print("  leg join done", file=sys.stderr, flush=True)
+
+    # --- leg 4: serde-encoded shuffle (byte payload round-trip) ---------
+    n = mesh * max(records_per_device // 4, 64)
+    keys = rng.integers(0, 2**31, size=(n, 2), dtype=np.uint32)
+    lens = rng.integers(0, 25, size=n)
+    payloads = [bytes(rng.integers(0, 256, size=int(ln), dtype=np.uint8))
+                for ln in lens]
+    rows_enc = encode_bytes_rows(keys, payloads, 24)
+    back = Dataset.from_host_rows(m, rows_enc).repartition().to_host_rows()
+    k2, p2 = decode_bytes_rows(back, 2)
+    out["serde"] = {tuple(map(int, k2[i])): p2[i] for i in range(len(p2))}
+    print("  leg serde done", file=sys.stderr, flush=True)
+
+    # --- leg 5: checkpoint resume (kill the live map output, reload) ----
+    x5 = rng.integers(0, 2**32, size=(mesh * records_per_device, w),
+                      dtype=np.uint32)
+    h5 = m.register_shuffle(5, mesh, part)
+    try:
+        m.get_writer(h5).write(rt.shard_records(x5)).stop(True)
+        m._writers[5]._records = None     # executor loss: host copy only
+        m.resume_shuffle(h5)              # checkpoint.read / spill.read
+        rows5, tot5 = m.get_reader(h5).read()
+        out["resume"] = (host(rows5).copy(), host(tot5).copy())
+    finally:
+        m.unregister_shuffle(5)
+    print("  leg resume done", file=sys.stderr, flush=True)
+    return out
+
+
+def outputs_equal(a, b) -> bool:
+    import numpy as np
+
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, tuple):
+        return len(a) == len(b) and all(
+            outputs_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, np.ndarray):
+        return a.shape == b.shape and a.dtype == b.dtype \
+            and bool(np.array_equal(a, b))
+    return a == b
+
+
+def read_spans(path: str) -> list:
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "retry_count" in obj:     # span lines (not rollup/heartbeat)
+                spans.append(obj)
+    return spans
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="shuffle chaos soak: workloads under injected faults, "
+                    "bit-identical vs a fault-free control")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="schedule + data seed (failing seeds replay)")
+    ap.add_argument("--records-per-device", type=int, default=2048)
+    ap.add_argument("--host-devices", type=int, default=8,
+                    help="simulated CPU device count when no XLA_FLAGS "
+                         "override is present (0 = leave env alone)")
+    args = ap.parse_args(argv)
+
+    if args.host_devices and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={args.host_devices}")
+
+    import numpy as np  # noqa: F401  (workload legs need it importable)
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf, faults
+    from sparkrdma_tpu.api import serde
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    pyrng = random.Random(args.seed)
+    spec = build_spec(pyrng, include_serde=serde.native_codec_available())
+
+    common = dict(
+        slot_records=256,
+        max_rounds=64,
+        max_rounds_in_flight=1,      # force the streaming regime
+        val_words=7,                 # fits the 24-byte serde payloads
+        spill_to_host=True,          # every stop() checkpoints
+        max_retry_attempts=8,
+        retry_backoff_ms=1.0,
+        retry_deadline_s=60.0,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="chaos_soak_") as tmp:
+        # --- control pass: no faults -----------------------------------
+        print("control pass (no faults)...", file=sys.stderr, flush=True)
+        conf_c = ShuffleConf(spill_dir=os.path.join(tmp, "ctl"), **common)
+        mc = ShuffleManager(MeshRuntime(conf_c), conf_c)
+        try:
+            control = run_legs(mc, args.seed, args.records_per_device)
+        finally:
+            mc.stop()
+
+        faults.reset_accounting()
+        serde._reset_native_degrade()
+
+        # --- chaos pass: same data, fault schedule active --------------
+        print(f"chaos pass: {spec}", file=sys.stderr, flush=True)
+        journal = os.path.join(tmp, "journal.jsonl")
+        conf_x = ShuffleConf(spill_dir=os.path.join(tmp, "chaos"),
+                             fault_spec=spec, metrics_sink=journal,
+                             **common)
+        mx = ShuffleManager(MeshRuntime(conf_x), conf_x)
+        try:
+            chaos = run_legs(mx, args.seed, args.records_per_device)
+            plane = mx.faults
+        finally:
+            mx.stop()
+        serde._reset_native_degrade()
+
+        spans = read_spans(journal)
+        retries = sum(int(s.get("retry_count") or 0) for s in spans)
+        backoffs = [b for s in spans for b in (s.get("backoff_ms") or [])]
+        spans_missing_backoff = [
+            s["span_id"] for s in spans
+            if (s.get("retry_count") or 0) > 0 and not s.get("backoff_ms")]
+
+    injected = plane.injected_counts()
+    hard = plane.injected_total(("fail", "corrupt"))
+    recoveries = faults.recovery_counts()
+    degradations = faults.active_degradations()
+    books = hard == retries + faults.recovery_total() \
+        + faults.degradation_total()
+
+    identical = {leg: outputs_equal(control[leg], chaos[leg])
+                 for leg in control}
+    sites = plane.sites_hit()
+    ok = (all(identical.values()) and len(sites) >= 6 and books
+          and not spans_missing_backoff)
+
+    print(json.dumps({
+        "ok": ok,
+        "seed": args.seed,
+        "fault_spec": spec,
+        "sites_hit": sorted(sites),
+        "injected": injected,
+        "hard_injections": hard,
+        "journal_retries": retries,
+        "recoveries": recoveries,
+        "degradations": degradations,
+        "books_balanced": books,
+        "backoff_ms_total": round(sum(backoffs), 3),
+        "spans_missing_backoff": spans_missing_backoff,
+        "bit_identical": identical,
+    }, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

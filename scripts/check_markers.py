@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Test-hygiene lint, run at the top of the tier-1 command (ROADMAP.md).
 
-Three invariants keep the CPU tier-1 suite honest:
+Four invariants keep the CPU tier-1 suite honest:
 
 1. **Importability** — every ``tests/test_*.py`` must import cleanly
    under ``JAX_PLATFORMS=cpu``. A module that dies at import time makes
@@ -22,6 +22,11 @@ Three invariants keep the CPU tier-1 suite honest:
    The CLIs are stdlib-only and never import the dataclass or the
    field sets, so a schema rename would otherwise silently turn their
    reads into defaults instead of failing.
+4. **Fault-site sync** — every ``faults.fire("<site>")`` call in the
+   package must name a site registered in ``faults.SITES`` (what the
+   ``fault_spec`` parser accepts), and every registered site must have
+   at least one call site — schedules and injection points cannot
+   silently drift apart.
 
 Static checks only read source; the import check executes module tops,
 which for this suite is cheap (heavy work lives inside test bodies).
@@ -118,6 +123,46 @@ def check_span_schema_sync() -> str:
     return "\n".join(bad)
 
 
+#: fault-site call pattern: ``faults.fire("<site>")`` / ``_faults.fire``
+#: (the single entry point every layer uses to consult the active plane)
+FIRE_CALL = re.compile(r'\b(?:_?faults)\.fire\(\s*"([a-z0-9_.]+)"')
+
+
+def check_fault_site_sync() -> str:
+    """Every ``faults.fire("<site>")`` call in the package must name a
+    registered site, and every registered site must have at least one
+    call site — so the ``fault_spec`` parser never accepts a site name
+    that nothing fires (a schedule written against it would silently
+    inject nothing) and no layer fires an unregistered name (which
+    ``FaultPlane.check`` rejects at runtime, but only when a spec is
+    active). Same style as the span-schema sync lint: source-only scan,
+    conventions pinned by regex.
+    """
+    from sparkrdma_tpu.faults import SITES
+
+    fired: dict[str, list[str]] = {}
+    pkg = REPO / "sparkrdma_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        if path.name == "faults.py":
+            continue   # the registry itself, not a call site
+        src = path.read_text(encoding="utf-8")
+        for m in FIRE_CALL.finditer(src):
+            fired.setdefault(m.group(1), []).append(
+                str(path.relative_to(REPO)))
+    bad = []
+    for site, where in sorted(fired.items()):
+        if site not in SITES:
+            bad.append(f"{where[0]} fires unregistered fault site "
+                       f"{site!r} — add it to faults.SITES or fix the "
+                       "call")
+    for site in SITES:
+        if site not in fired:
+            bad.append(f"faults.SITES registers {site!r} but no "
+                       "faults.fire(...) call site exists in the package "
+                       "— a fault_spec naming it would inject nothing")
+    return "\n".join(bad)
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, str(REPO))
@@ -136,13 +181,17 @@ def main() -> int:
     err = check_span_schema_sync()
     if err:
         failures.append(("schema-sync", "scripts", err))
+    err = check_fault_site_sync()
+    if err:
+        failures.append(("fault-site-sync", "sparkrdma_tpu", err))
     if failures:
         print(f"check_markers: {len(failures)} failure(s)", file=sys.stderr)
         for kind, name, err in failures:
             print(f"--- [{kind}] {name}\n{err}", file=sys.stderr)
         return 1
     print(f"check_markers: {len(modules)} test modules importable, "
-          "slow markers consistent, CLI span reads schema-synced")
+          "slow markers consistent, CLI span reads schema-synced, "
+          "fault sites synced")
     return 0
 
 

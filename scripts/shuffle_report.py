@@ -440,9 +440,44 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             f"fetch retries in shuffle(s) {retried}: backend failures "
             "were recovered from checkpoints — check device health; "
             "raise max_retry_attempts only if failures are transient")
+    backoff_total = sum(b for s in spans
+                        for b in (s.get("backoff_ms") or []))
+    if backoff_total > 0:
+        findings.append(
+            f"{backoff_total:,.0f} ms spent in retry backoff: persistent "
+            "fetch failures are being paced (retry_backoff_ms) — if "
+            "reads hit the retry deadline, the fault is not transient; "
+            "fix the underlying transport/storage instead of raising "
+            "retry_deadline_s")
+    degraded = sorted({d for s in spans
+                       for d in (s.get("degraded") or [])})
+    if degraded:
+        hints = {
+            "serde_native": "native codec failed; running on the "
+                            "bit-identical numpy path (slower) — rebuild "
+                            "native/ and check its logs",
+            "transport": "configured transport failed to construct; "
+                         "running on the plain xla all_to_all — check "
+                         "the ring/hierarchical prerequisites",
+        }
+        detail = "; ".join(f"{d}: {hints.get(d, 'see faults.py ladder')}"
+                           for d in degraded)
+        findings.append(
+            f"sticky degradation(s) active {degraded} — results stay "
+            f"correct but slower ({detail})")
+    corrupt = [e for s in spans for e in (s.get("events") or [])
+               if e.get("name") == "fault:injected"
+               and e.get("action") == "corrupt"]
+    if corrupt:
+        findings.append(
+            f"{len(corrupt)} checksum-relevant corruption event(s) in "
+            "span timelines: CRC-verified spill/checkpoint reads caught "
+            "(or injected schedules simulated) bit flips — if these are "
+            "not injected, suspect the storage under spill_dir")
     if not findings:
-        findings.append("no issues detected: skew, spills, stalls and "
-                        "retries all within normal bounds")
+        findings.append("no issues detected: skew, spills, stalls, "
+                        "retries and degradations all within normal "
+                        "bounds")
     return findings
 
 

@@ -71,6 +71,33 @@ _PICKLE_TAIL = b"l."          # LIST, STOP
 
 _layout_checked: Optional[bool] = None
 
+# Graceful-degradation ladder, serde rung: the first non-data-error
+# failure inside the native branch permanently (per process) falls the
+# codec back to the bit-identical numpy path. Sticky by design — a codec
+# that failed once is not trusted again; data errors (ValueError: the
+# oversize / corrupt-length contract) are NOT failures of the codec and
+# re-raise unchanged on both paths.
+_native_disabled: bool = False
+_native_disabled_reason: str = ""
+
+
+def _degrade_native(op: str, exc: BaseException) -> None:
+    global _native_disabled, _native_disabled_reason
+    if not _native_disabled:
+        _native_disabled = True
+        _native_disabled_reason = f"{op}: {exc}"
+        from sparkrdma_tpu import faults as _faults
+
+        _faults.note_degradation("serde_native",
+                                 reason=_native_disabled_reason)
+
+
+def _reset_native_degrade() -> None:
+    """Test hook: re-arm the native codec after a sticky degradation."""
+    global _native_disabled, _native_disabled_reason
+    _native_disabled = False
+    _native_disabled_reason = ""
+
 
 def payload_words(max_payload_bytes: int) -> int:
     """Words one payload slot occupies: 1 length word + ceil(bytes/4)."""
@@ -226,50 +253,63 @@ def encode_bytes_rows(
     elif (out.shape != (n, w) or out.dtype != np.uint32
           or not out.flags.c_contiguous):
         raise ValueError(f"out must be C-contiguous uint32[{n}, {w}]")
-    use_native = native is not False and n > 0 and native_codec_available()
+    use_native = (native is not False and n > 0 and not _native_disabled
+                  and native_codec_available())
     if use_native:
-        from sparkrdma_tpu.hbm.host_staging import load_native
-
-        lib = load_native()
-        # a numpy object array's storage is a contiguous PyObject*
-        # vector: the C threads read each bytes object's size and bytes
-        # directly (offsets canary-verified in _layout_ok), so the only
-        # Python-side cost is this C-speed element copy
-        objs = np.empty(n, dtype=object)
-        coerced = False
         try:
-            objs[:] = payloads
-        except ValueError:
-            # e.g. a list of equal-length uint8 arrays, which numpy
-            # would try to broadcast as a 2-D block
-            payloads = _coerce_payloads(payloads)
-            coerced = True
-            objs[:] = payloads
+            from sparkrdma_tpu import faults as _faults
+            if _faults.fire("serde.encode") == "fail":
+                raise RuntimeError(
+                    "injected fault (serde.encode): native codec failure")
+            from sparkrdma_tpu.hbm.host_staging import load_native
 
-        def _call() -> int:
-            return int(lib.sr_encode_rows(
-                objs.ctypes.data, id(bytes), _SIZE_OFF, _DATA_OFF,
-                keys.ctypes.data, n, kw, slot_words, max_payload_bytes,
-                out.ctypes.data, _auto_threads(threads)))
+            lib = load_native()
+            # a numpy object array's storage is a contiguous PyObject*
+            # vector: the C threads read each bytes object's size and
+            # bytes directly (offsets canary-verified in _layout_ok), so
+            # the only Python-side cost is this C-speed element copy
+            objs = np.empty(n, dtype=object)
+            coerced = False
+            try:
+                objs[:] = payloads
+            except ValueError:
+                # e.g. a list of equal-length uint8 arrays, which numpy
+                # would try to broadcast as a 2-D block
+                payloads = _coerce_payloads(payloads)
+                coerced = True
+                objs[:] = payloads
 
-        rc = _call()
-        if rc < 0 and not coerced:
-            # a non-bytes payload (or an oversize one) — normalize,
-            # which raises the precise error for non-buffer rows, then
-            # retry once
-            payloads = _coerce_payloads(payloads)
-            objs[:] = payloads
+            def _call() -> int:
+                return int(lib.sr_encode_rows(
+                    objs.ctypes.data, id(bytes), _SIZE_OFF, _DATA_OFF,
+                    keys.ctypes.data, n, kw, slot_words, max_payload_bytes,
+                    out.ctypes.data, _auto_threads(threads)))
+
             rc = _call()
-        if rc < 0:
-            # all payloads are bytes now, so the only legal failure is
-            # an oversize payload; raise the shared error message
-            lens = np.fromiter(map(len, payloads), np.int64, count=n)
-            if int(lens.max(initial=0)) > max_payload_bytes:
-                raise _oversize_error(lens, max_payload_bytes)
-            raise RuntimeError(
-                f"native encoder rejected row {-rc - 1} after coercion "
-                "— codec inconsistency")
-    else:
+            if rc < 0 and not coerced:
+                # a non-bytes payload (or an oversize one) — normalize,
+                # which raises the precise error for non-buffer rows,
+                # then retry once
+                payloads = _coerce_payloads(payloads)
+                objs[:] = payloads
+                rc = _call()
+            if rc < 0:
+                # all payloads are bytes now, so the only legal failure
+                # is an oversize payload; raise the shared error message
+                lens = np.fromiter(map(len, payloads), np.int64, count=n)
+                if int(lens.max(initial=0)) > max_payload_bytes:
+                    raise _oversize_error(lens, max_payload_bytes)
+                raise RuntimeError(
+                    f"native encoder rejected row {-rc - 1} after "
+                    "coercion — codec inconsistency")
+        except ValueError:
+            raise  # data-error contract (oversize / non-bytes payload)
+        except Exception as exc:
+            # codec failure → sticky fall-back to the bit-identical
+            # numpy path; the numpy branch below fully rewrites `out`
+            _degrade_native("encode", exc)
+            use_native = False
+    if not use_native:
         if set(map(type, payloads)) - {bytes}:
             payloads = _coerce_payloads(payloads)
         # bulk numpy encode (round 5 — the per-row frombuffer loop
@@ -312,45 +352,57 @@ def decode_bytes_rows(
     slot_words = w - key_words - 1
     max_bytes = slot_words * 4
     use_native = (native is not False and n > 0 and slot_words > 0
-                  and native_codec_available())
+                  and not _native_disabled and native_codec_available())
     if use_native:
-        import pickle
+        try:
+            from sparkrdma_tpu import faults as _faults
+            if _faults.fire("serde.decode") == "fail":
+                raise RuntimeError(
+                    "injected fault (serde.decode): native codec failure")
+            import pickle
 
-        from sparkrdma_tpu.hbm.host_staging import load_native
+            from sparkrdma_tpu.hbm.host_staging import load_native
 
-        lib = load_native()
-        crows = np.ascontiguousarray(rows)
-        keys = np.empty((n, key_words), dtype=np.uint32)
-        # plan pass: one serial C sweep validates every length word and
-        # lays out the pickle-item stream (per-row offsets + total size)
-        soff = np.empty(n, dtype=np.int64)
-        total = int(lib.sr_decode_plan(
-            crows.ctypes.data, n, key_words, slot_words,
-            len(_PICKLE_HEAD), soff.ctypes.data))
-        if total < 0:
-            i = -total - 1
-            raise ValueError(
-                f"row {i} declares {int(crows[i, key_words])} payload "
-                f"bytes but the slot holds {max_bytes} — corrupt length "
-                "word")
-        # scatter pass: the C threads write each payload as a pickle
-        # protocol-3 item (SHORT_BINBYTES/BINBYTES — frozen format) at
-        # soff[i]; one loads() call then builds all n bytes objects
-        # inside the C unpickler, ~2x faster than a GIL-bound per-row
-        # slice loop
-        buf = np.empty(len(_PICKLE_HEAD) + total + len(_PICKLE_TAIL),
-                       dtype=np.uint8)
-        buf[:len(_PICKLE_HEAD)] = np.frombuffer(_PICKLE_HEAD, np.uint8)
-        buf[len(_PICKLE_HEAD) + total:] = np.frombuffer(_PICKLE_TAIL,
-                                                        np.uint8)
-        rc = int(lib.sr_decode_rows(
-            crows.ctypes.data, n, key_words, slot_words, keys.ctypes.data,
-            soff.ctypes.data, buf.ctypes.data, _auto_threads(threads)))
-        if rc < 0:  # unreachable after the plan validation; defensive
-            raise ValueError(f"row {-rc - 1} rejected by native decoder "
-                             "— corrupt length word")
-        payloads = pickle.loads(memoryview(buf))
-    else:
+            lib = load_native()
+            crows = np.ascontiguousarray(rows)
+            keys = np.empty((n, key_words), dtype=np.uint32)
+            # plan pass: one serial C sweep validates every length word
+            # and lays out the pickle-item stream (per-row offsets +
+            # total size)
+            soff = np.empty(n, dtype=np.int64)
+            total = int(lib.sr_decode_plan(
+                crows.ctypes.data, n, key_words, slot_words,
+                len(_PICKLE_HEAD), soff.ctypes.data))
+            if total < 0:
+                i = -total - 1
+                raise ValueError(
+                    f"row {i} declares {int(crows[i, key_words])} payload "
+                    f"bytes but the slot holds {max_bytes} — corrupt "
+                    "length word")
+            # scatter pass: the C threads write each payload as a pickle
+            # protocol-3 item (SHORT_BINBYTES/BINBYTES — frozen format)
+            # at soff[i]; one loads() call then builds all n bytes
+            # objects inside the C unpickler, ~2x faster than a
+            # GIL-bound per-row slice loop
+            buf = np.empty(len(_PICKLE_HEAD) + total + len(_PICKLE_TAIL),
+                           dtype=np.uint8)
+            buf[:len(_PICKLE_HEAD)] = np.frombuffer(_PICKLE_HEAD, np.uint8)
+            buf[len(_PICKLE_HEAD) + total:] = np.frombuffer(_PICKLE_TAIL,
+                                                            np.uint8)
+            rc = int(lib.sr_decode_rows(
+                crows.ctypes.data, n, key_words, slot_words,
+                keys.ctypes.data, soff.ctypes.data, buf.ctypes.data,
+                _auto_threads(threads)))
+            if rc < 0:  # unreachable after plan validation; defensive
+                raise ValueError(f"row {-rc - 1} rejected by native "
+                                 "decoder — corrupt length word")
+            payloads = pickle.loads(memoryview(buf))
+        except ValueError:
+            raise  # data-error contract (corrupt length word)
+        except Exception as exc:
+            _degrade_native("decode", exc)
+            use_native = False
+    if not use_native:
         lens = rows[:, key_words]
         if n and int(lens.max(initial=0)) > max_bytes:
             i = int(np.argmax(lens > max_bytes))

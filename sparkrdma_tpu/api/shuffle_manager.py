@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -40,8 +41,10 @@ import jax.numpy as jnp
 from jax import lax
 import numpy as np
 
+from sparkrdma_tpu import faults as _faults
 from sparkrdma_tpu.config import ShuffleConf
-from sparkrdma_tpu.exchange.errors import FetchFailedError
+from sparkrdma_tpu.exchange.errors import (FetchFailedError,
+                                           UnrecoverableShuffleError)
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
 from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
@@ -231,6 +234,13 @@ class ShuffleReader:
                                      shuffle_id=self._h.shuffle_id)
         post_s = 0.0   # separate filter/agg/sort program wall-clock
         attempt = 0
+        # retry hardening: a wall-clock deadline across ALL attempts (the
+        # bound that makes "max_retry_attempts with backoff" finite in
+        # time, not just in count) plus per-attempt exponential backoff
+        # with deterministic jitter (faults.backoff_ms). Both default off.
+        deadline = (time.monotonic() + conf.retry_deadline_s
+                    if conf.retry_deadline_s > 0 else None)
+        backoffs: list = []   # per-attempt sleeps taken, ms (span field)
         while True:
             attempt += 1
             try:
@@ -317,12 +327,34 @@ class ShuffleReader:
                         f"giving up after {attempt} attempts",
                         attempt,
                     ) from e
+                if deadline is not None and time.monotonic() >= deadline:
+                    # terminal, not retry-forever: the deadline converts
+                    # a persistent fault into ONE clean failure
+                    raise FetchFailedError(
+                        self._h.shuffle_id,
+                        f"retry deadline {conf.retry_deadline_s}s "
+                        f"exceeded after {attempt} attempts",
+                        attempt,
+                    ) from e
                 log.warning(
                     "shuffle %d fetch failed (attempt %d/%d): %s; "
                     "retrying", self._h.shuffle_id, attempt,
                     conf.max_retry_attempts, e)
                 self._m.timeline.event("retry", attempt=attempt,
                                        shuffle=self._h.shuffle_id)
+                delay_ms = _faults.backoff_ms(attempt,
+                                              conf.retry_backoff_ms,
+                                              span_id)
+                if delay_ms > 0:
+                    if deadline is not None:
+                        # never sleep past the deadline itself
+                        delay_ms = min(delay_ms, max(
+                            (deadline - time.monotonic()) * 1e3, 0.0))
+                    backoffs.append(round(delay_ms, 3))
+                    self._m.timeline.event("retry:backoff",
+                                           attempt=attempt,
+                                           ms=round(delay_ms, 3))
+                    time.sleep(delay_ms / 1e3)
                 writer = self._m._recover_writer(self._h)
         plan = writer.plan
         if record_stats:
@@ -347,7 +379,7 @@ class ShuffleReader:
                 span = ExchangeSpan(
                     span_id=span_id,
                     shuffle_id=self._h.shuffle_id,
-                    transport=self._m.conf.transport,
+                    transport=ex.transport(),
                     rounds=plan.num_rounds,
                     dispatches=ex.last_dispatches,
                     records=plan.total_records,
@@ -363,6 +395,8 @@ class ShuffleReader:
                                      if pool is not None else 0),
                     spill_count=spill_count(),
                     retry_count=attempt - 1,
+                    backoff_ms=backoffs,
+                    degraded=_faults.active_degradations(),
                     serde_encode_bytes=serde["serde_encode_bytes"],
                     serde_encode_s=serde["serde_encode_s"],
                     serde_decode_bytes=serde["serde_decode_bytes"],
@@ -571,6 +605,12 @@ class ShuffleManager:
                                       timeline=self.timeline)
         if self.watchdog.enabled:
             install_state_dump()   # SIGUSR1 armed-wait dump (best effort)
+        # chaos plane: deterministic fault schedules from conf.fault_spec,
+        # installed process-wide (module-level sites — staging, serde,
+        # checkpoint — reach it without a handle through every signature)
+        self.faults = _faults.FaultPlane(self.conf.fault_spec)
+        self._prev_plane = _faults.set_active_plane(
+            self.faults if self.faults.enabled else None)
         # the runtime's SlotPool serves exchange recv/output buffers
         # (RdmaBufferManager wiring: the node owns the pool, channels use it)
         if self.runtime.pool is not None:
@@ -693,26 +733,37 @@ class ShuffleManager:
                 "map stage instead of resuming")
         shape = tuple(meta["shape"])
         shard_len = shape[1] // mesh_now
-        if meta.get("sharded"):
-            # per-process reload: the callback is only ever invoked for
-            # this process's addressable shards, so each process touches
-            # only its own files (its executor-local shuffle files)
-            store, sid = self.store, handle.shuffle_id
+        try:
+            if meta.get("sharded"):
+                # per-process reload: the callback is only ever invoked
+                # for this process's addressable shards, so each process
+                # touches only its own files (executor-local shuffle
+                # files)
+                store, sid = self.store, handle.shuffle_id
 
-            def read(idx):
-                coord = int(idx[1].start or 0) // shard_len
-                return store.read_shard(sid, coord,
-                                        (shape[0], shard_len))[idx[0], :]
+                def read(idx):
+                    coord = int(idx[1].start or 0) // shard_len
+                    return store.read_shard(
+                        sid, coord, (shape[0], shard_len))[idx[0], :]
 
-            records = jax.make_array_from_callback(
-                shape, self.runtime.sharding(None, self.runtime.axis_name),
-                read)
-        else:
-            records_np = self.store.read_records(handle.shuffle_id, meta)
-            records = jax.make_array_from_callback(
-                records_np.shape,
-                self.runtime.sharding(None, self.runtime.axis_name),
-                lambda idx: records_np[idx])
+                records = jax.make_array_from_callback(
+                    shape,
+                    self.runtime.sharding(None, self.runtime.axis_name),
+                    read)
+            else:
+                records_np = self.store.read_records(handle.shuffle_id,
+                                                     meta)
+                records = jax.make_array_from_callback(
+                    records_np.shape,
+                    self.runtime.sharding(None, self.runtime.axis_name),
+                    lambda idx: records_np[idx])
+        except OSError as e:
+            # the checkpoint failed CRC verification (or is unreadable)
+            # even after the storage layer's bounded re-read: the live
+            # map output is gone AND the persisted copy is bad, so a
+            # retry would re-read the same corrupt bytes — terminal.
+            raise UnrecoverableShuffleError(
+                handle.shuffle_id, f"checkpoint unreadable: {e}") from e
         w = ShuffleWriter(self, handle)
         # checkpoints store the columnar [W, N] batch; reshard over N
         # (make_array_from_callback: works when some devices are
@@ -741,6 +792,8 @@ class ShuffleManager:
         )
 
     def stop(self) -> None:
+        if _faults.active_plane() is self.faults:
+            _faults.set_active_plane(self._prev_plane)
         if self.stats.enabled and self.stats.records:
             self.stats.print_histogram()
         if self.heartbeat is not None:

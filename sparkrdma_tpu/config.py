@@ -229,6 +229,33 @@ class ShuffleConf:
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue
     fault_injection_rate: float = 0.0  # probability of injected exchange fault
+    #: unified fault plane (sparkrdma_tpu.faults): ``;``-joined
+    #: ``site:action[@predicate]`` rules injecting deterministic faults
+    #: at named sites across every layer, e.g.
+    #: ``"exchange.dispatch:fail@attempt<2;spill.read:corrupt@0.01;
+    #: pool.acquire:delay=50ms@0.05"``. Actions: fail / corrupt /
+    #: delay=<N>ms; predicates: attempt<N (first N hits) or a
+    #: deterministic rate in (0,1]; empty (default) = no injection.
+    #: Subsumes ``fault_injection_rate`` (kept as a compat shim on the
+    #: ``exchange.dispatch`` site).
+    fault_spec: str = ""
+    #: exponential-backoff base for the FetchFailedError retry loop:
+    #: retry attempt k sleeps ~``retry_backoff_ms * 2^(k-1)`` ms with
+    #: deterministic jitter in [0.5x, 1.0x) (sparkrdma_tpu.faults
+    #: .backoff_ms — same schedule on every host for the same span).
+    #: 0 (default) = no backoff (the pre-chaos-plane hot retry).
+    retry_backoff_ms: float = 0.0
+    #: wall-clock retry deadline: once this many seconds have elapsed
+    #: since the read's first attempt, the next FetchFailedError is
+    #: terminal even if max_retry_attempts is not yet exhausted — a
+    #: persistent fault costs bounded wall-clock, never retry-forever.
+    #: 0 (default) = attempts-bounded only.
+    retry_deadline_s: float = 0.0
+    #: graceful degradation: when True, a pallas_ring / hierarchical
+    #: transport that fails to build falls back to the "xla" transport
+    #: for the rest of the process (sticky, counted as
+    #: ``degrade.transport``) instead of failing the job.
+    transport_fallback: bool = False
 
     # --- host staging / spill ---
     spill_to_host: bool = False
@@ -305,7 +332,14 @@ class ShuffleConf:
         if self.serde_chunk_records < 0:
             raise ValueError("serde_chunk_records must be >= 0 (0 = no "
                              "chunking)")
+        if not 0.0 <= self.fault_injection_rate <= 1.0:
+            raise ValueError("fault_injection_rate must be in [0, 1]")
+        if self.retry_backoff_ms < 0:
+            raise ValueError("retry_backoff_ms must be >= 0 (0 disables)")
+        if self.retry_deadline_s < 0:
+            raise ValueError("retry_deadline_s must be >= 0 (0 disables)")
         self.sampling_policy()  # validate journal_sample eagerly
+        self.fault_rules()      # validate fault_spec eagerly
         _parse_prealloc(self.prealloc)  # validate eagerly
 
     @property
@@ -327,6 +361,12 @@ class ShuffleConf:
         # root finishes initializing (obs.journal is stdlib-only)
         from sparkrdma_tpu.obs.journal import SamplingPolicy
         return SamplingPolicy.parse(self.journal_sample)
+
+    def fault_rules(self):
+        """Parsed ``fault_spec`` (sparkrdma_tpu.faults.FaultRule list)."""
+        # local import for the same reason as sampling_policy
+        from sparkrdma_tpu.faults import parse_fault_spec
+        return parse_fault_spec(self.fault_spec)
 
     def replace(self, **kw) -> "ShuffleConf":
         return dataclasses.replace(self, **kw)

@@ -30,4 +30,21 @@ class FetchFailedError(RuntimeError):
         )
 
 
-__all__ = ["FetchFailedError"]
+class UnrecoverableShuffleError(RuntimeError):
+    """The shuffle cannot make progress and retrying will not help.
+
+    Raised when every recovery rung is exhausted — e.g. the live map
+    output is gone AND the host checkpoint fails CRC verification, so a
+    retry would only re-read the same corrupt bytes. The contract is ONE
+    clean terminal error (Spark: the stage is aborted and the job fails),
+    never a retry-forever loop around detected corruption.
+    """
+
+    def __init__(self, shuffle_id: int, message: str = ""):
+        self.shuffle_id = shuffle_id
+        super().__init__(
+            f"shuffle {shuffle_id} unrecoverable"
+            + (f": {message}" if message else ""))
+
+
+__all__ = ["FetchFailedError", "UnrecoverableShuffleError"]

@@ -254,10 +254,39 @@ class ShuffleExchange:
         self._fault_rng = np.random.default_rng(0xFA17)
         #: wall-clock of the most recent plan() — folded into spans
         self.last_plan_s = 0.0
+        # graceful-degradation ladder, transport rung: when a ring /
+        # hierarchical transport fails to construct and
+        # conf.transport_fallback is on, the exchange permanently (per
+        # instance) falls back to the plain xla all_to_all. Sticky —
+        # flapping between transports would thrash the compile cache.
+        self._transport_override: Optional[str] = None
+
+    def transport(self) -> str:
+        """The transport actually in use (conf choice, or the sticky
+        ``xla`` fallback after a transport degradation)."""
+        return self._transport_override or self.conf.transport
+
+    def _degrade_transport(self, exc: BaseException) -> None:
+        if not self.conf.transport_fallback:
+            raise exc
+        from sparkrdma_tpu import faults as _faults
+
+        self._transport_override = "xla"
+        # compiled programs embed the dead transport; rebuild on demand
+        self._exec_cache.clear()
+        self.metrics.counter("exchange.transport_fallbacks").inc()
+        _faults.note_degradation(
+            "transport", reason=f"{self.conf.transport}: {exc}")
 
     def _maybe_inject_fault(self, shuffle_id: int = -1) -> None:
+        from sparkrdma_tpu import faults as _faults
         from sparkrdma_tpu.exchange.errors import FetchFailedError
 
+        if _faults.fire("exchange.dispatch") == "fail":
+            # the plane already counted + journaled the injection
+            self.metrics.counter("exchange.faults").inc()
+            raise FetchFailedError(
+                shuffle_id, "injected fault (fault_spec: exchange.dispatch)")
         if self.fault_hook is not None:
             if self.fault_hook():
                 self.metrics.counter("exchange.faults").inc()
@@ -378,18 +407,24 @@ class ShuffleExchange:
         """The configured data-round transport: dest-major slot tensor
         ``[mesh, ...]`` -> source-major received tensor."""
         ax = self.axis_name
-        if self.conf.transport == "pallas_ring":
-            from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
+        if self.transport() == "pallas_ring":
+            try:
+                from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
 
-            return make_ring_all_to_all(self.mesh, ax,
-                                        metrics=self.metrics)
-        if self.conf.transport == "hierarchical":
-            from sparkrdma_tpu.exchange.hierarchical import (
-                make_hierarchical_all_to_all)
+                return make_ring_all_to_all(self.mesh, ax,
+                                            metrics=self.metrics)
+            except Exception as exc:  # degradation ladder (or re-raise)
+                self._degrade_transport(exc)
+        if self.transport() == "hierarchical":
+            try:
+                from sparkrdma_tpu.exchange.hierarchical import (
+                    make_hierarchical_all_to_all)
 
-            return make_hierarchical_all_to_all(
-                self.mesh, ax, self.conf.hierarchy_hosts,
-                metrics=self.metrics)
+                return make_hierarchical_all_to_all(
+                    self.mesh, ax, self.conf.hierarchy_hosts,
+                    metrics=self.metrics)
+            except Exception as exc:  # degradation ladder (or re-raise)
+                self._degrade_transport(exc)
 
         def a2a(slots):
             return lax.all_to_all(slots, ax, split_axis=0,
@@ -611,7 +646,7 @@ class ShuffleExchange:
                 # VMA inference cannot type pallas kernels (ring
                 # transport's device-id arithmetic, merge-sort's grid
                 # indices); pure-XLA programs keep the check
-                check_vma=(self.conf.transport == "xla"
+                check_vma=(self.transport() == "xla"
                            and not self._uses_fast_sort(
                                out_capacity, sort_key_words, aggregator)),
             ),
@@ -646,7 +681,7 @@ class ShuffleExchange:
             local_prep, mesh=self.mesh,
             in_specs=(P(None, ax),),
             out_specs=(P(None, ax), P(ax), P(ax), P(ax), P(ax)),
-            check_vma=(self.conf.transport == "xla"),
+            check_vma=(self.transport() == "xla"),
         ))
 
     def _build_chunk(self, num_parts: int, capacity: int, rounds_per: int,
@@ -853,10 +888,22 @@ class ShuffleExchange:
                 self._exec_cache[zkey] = zfn
             return zfn()
 
+        from sparkrdma_tpu import faults as _faults
+        from sparkrdma_tpu.exchange.errors import FetchFailedError
+
         acc = get_buf(acc_shape, out_sharding)
         tl = self.timeline
         in_flight = []   # completion tokens of dispatched chunks
         for j in range(n_chunks):
+            if _faults.fire("exchange.stream_round") == "fail":
+                # a mid-stream failure abandons the whole exchange (the
+                # accumulator holds partial rounds); the reader's retry
+                # loop restarts from the still-published map outputs
+                self.metrics.counter("exchange.faults").inc()
+                raise FetchFailedError(
+                    shuffle_id,
+                    f"injected fault (fault_spec: exchange.stream_round, "
+                    f"chunk {j})")
             if len(in_flight) >= conf.queue_depth:
                 # the recvQueueDepth throttle: block on the oldest
                 # outstanding chunk before admitting a new one. This is
@@ -1077,7 +1124,7 @@ class ShuffleExchange:
             span = ExchangeSpan(
                 span_id=span_id,
                 shuffle_id=shuffle_id,
-                transport=self.conf.transport,
+                transport=self.transport(),
                 rounds=plan.num_rounds,
                 dispatches=self.last_dispatches,
                 records=plan.total_records,

@@ -1,0 +1,376 @@
+"""Unified fault plane — named injection sites threaded through every layer.
+
+The reference's fault story is Spark's ``FetchFailedException`` → stage
+retry (SURVEY §2.6/§5); ours mirrored it with a SINGLE injection site
+(`exchange/protocol._maybe_inject_fault`, fused-dispatch only). This
+module generalizes that into a registry of named **fault sites** crossing
+every layer of the shuffle:
+
+==========================  =================================================
+site                        where it fires
+==========================  =================================================
+``exchange.dispatch``       fused exchange, just before program dispatch
+``exchange.stream_round``   streaming exchange, top of each chunk iteration
+``pool.acquire``            SlotPool.get / get_shaped, before allocation
+``spill.write``             host_staging.write_array / SpillWriter.submit
+``spill.read``              host_staging.read_array, after load, pre-CRC
+``serde.encode``            api/serde.encode_bytes_rows, native branch
+``serde.decode``            api/serde.decode_bytes_rows, native branch
+``checkpoint.read``         MapOutputStore shard/records/meta reads
+==========================  =================================================
+
+Schedules are parsed from ``ShuffleConf.fault_spec``, a ``;``-joined list
+of ``site:action[@predicate]`` rules::
+
+    exchange.dispatch:fail@attempt<2;spill.read:corrupt@0.01;pool.acquire:delay=50ms@0.05
+
+- **actions**: ``fail`` (the call site raises its contract error —
+  ``FetchFailedError`` for exchange/pool sites, ``OSError`` for storage
+  sites, a simulated native-codec failure for serde), ``corrupt`` (flip a
+  bit in the data so the CRC trailer catches it; storage sites only),
+  ``delay=<N>ms`` (sleep, then proceed — latency injection).
+- **predicates**: ``attempt<N`` fires on the site's first ``N`` hits
+  then never again (the deterministic transient-fault schedule);
+  a float in ``(0, 1]`` fires pseudo-randomly at that rate but
+  DETERMINISTICALLY — the decision is splitmix64 of (seed, site, hit
+  index), so the same spec replays the same faults on every host and
+  every run; no predicate = every hit.
+
+Injections, recoveries and degradations are all tallied here (and
+mirrored to the global metrics registry as ``faults.*`` / ``recover.*``
+/ ``degrade.*`` counters plus ``fault:*`` timeline events), so
+``scripts/chaos_soak.py`` can close the accounting loop:
+every ``fail``/``corrupt`` injection must show up as a retry, a
+recovery, or a degradation — nothing absorbed silently.
+
+The plane is installed process-wide (`set_active_plane`, the same
+pattern as :func:`sparkrdma_tpu.obs.timeline.set_active`) by
+``ShuffleManager.__init__`` so module-level call sites (host staging,
+serde, checkpoint) reach it without threading a handle through every
+signature. ``fire(site)`` on an empty/absent plane is a constant no-op.
+
+The legacy single-site knobs (``ShuffleConf.fault_injection_rate`` and
+``ShuffleExchange.fault_hook``) remain as compat shims layered on the
+``exchange.dispatch`` site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+#: Every legal fault-site name. scripts/check_markers.py lints that each
+#: entry has at least one ``faults.fire("<site>")`` call site in the
+#: package and that no call site names an unregistered site.
+SITES: Tuple[str, ...] = (
+    "exchange.dispatch",
+    "exchange.stream_round",
+    "pool.acquire",
+    "spill.write",
+    "spill.read",
+    "serde.encode",
+    "serde.decode",
+    "checkpoint.read",
+)
+
+#: Sites whose payload a ``corrupt`` action can mangle (the data-carrying
+#: storage sites, where the CRC trailer is the detection contract).
+#: ``checkpoint.read`` is NOT here: checkpoint shards are read through
+#: the ``spill.read`` site (corrupt them there, or on disk directly).
+CORRUPTIBLE: Tuple[str, ...] = ("spill.write", "spill.read")
+
+_ACTIONS = ("fail", "corrupt", "delay")
+_DELAY_RE = re.compile(r"^delay=(\d+(?:\.\d+)?)ms$")
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer (same constants as obs.journal._mix64): the
+    rate predicate must be a pure function of (seed, site, hit index)."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return (x ^ (x >> 31)) & _MASK64
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One parsed ``site:action[@predicate]`` clause."""
+
+    site: str
+    action: str                 # "fail" | "corrupt" | "delay"
+    delay_ms: float = 0.0       # for action == "delay"
+    max_attempts: int = -1      # attempt<N predicate; -1 = not set
+    rate: float = -1.0          # rate predicate; -1 = not set
+
+    def matches(self, hit: int, seed: int) -> bool:
+        """Does this rule fire on the site's ``hit``-th visit (0-based)?"""
+        if self.max_attempts >= 0:
+            return hit < self.max_attempts
+        if self.rate >= 0:
+            h = _mix64(seed ^ zlib.crc32(self.site.encode()) ^ hit)
+            return (h / float(1 << 64)) < self.rate
+        return True
+
+
+def parse_fault_spec(spec: str) -> List[FaultRule]:
+    """Parse/validate a ``fault_spec`` string into ordered rules.
+
+    Raises ``ValueError`` on unknown sites, malformed actions and
+    predicates, or a ``corrupt`` action on a non-data-carrying site —
+    eagerly at ``ShuffleConf`` construction, not at first injection.
+    """
+    rules: List[FaultRule] = []
+    spec = (spec or "").strip()
+    if not spec:
+        return rules
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        site, sep, rest = clause.partition(":")
+        site = site.strip()
+        if not sep:
+            raise ValueError(f"fault_spec clause {clause!r}: expected "
+                             "'site:action[@predicate]'")
+        if site not in SITES:
+            raise ValueError(
+                f"fault_spec: unknown site {site!r} (known: "
+                f"{', '.join(SITES)})")
+        action_s, _, pred_s = rest.partition("@")
+        action_s = action_s.strip()
+        delay_ms = 0.0
+        m = _DELAY_RE.match(action_s)
+        if m:
+            action = "delay"
+            delay_ms = float(m.group(1))
+        elif action_s in ("fail", "corrupt"):
+            action = action_s
+        else:
+            raise ValueError(
+                f"fault_spec clause {clause!r}: unknown action "
+                f"{action_s!r} (use fail, corrupt, or delay=<N>ms)")
+        if action == "corrupt" and site not in CORRUPTIBLE:
+            raise ValueError(
+                f"fault_spec: 'corrupt' is only meaningful at data-"
+                f"carrying sites {CORRUPTIBLE}, not {site!r}")
+        max_attempts, rate = -1, -1.0
+        pred_s = pred_s.strip()
+        if pred_s:
+            am = re.match(r"^attempt<(\d+)$", pred_s)
+            if am:
+                max_attempts = int(am.group(1))
+            else:
+                try:
+                    rate = float(pred_s)
+                except ValueError:
+                    raise ValueError(
+                        f"fault_spec clause {clause!r}: bad predicate "
+                        f"{pred_s!r} (use attempt<N or a rate in (0,1])"
+                    ) from None
+                if not 0.0 < rate <= 1.0:
+                    raise ValueError(
+                        f"fault_spec clause {clause!r}: rate must be in "
+                        f"(0, 1], got {rate}")
+        rules.append(FaultRule(site, action, delay_ms, max_attempts, rate))
+    return rules
+
+
+class FaultPlane:
+    """A parsed schedule + per-site hit counters + injection tallies.
+
+    ``check(site)`` is the single entry point: it advances the site's
+    hit counter, evaluates rules in spec order (first match fires),
+    serves ``delay`` actions itself (sleeps, returns ``None``), and
+    returns ``"fail"`` / ``"corrupt"`` for the call site to translate
+    into its own error contract. Thread-safe; disabled planes short-
+    circuit before taking the lock.
+    """
+
+    def __init__(self, spec: str = "", seed: int = 0xFA17):
+        self.rules = parse_fault_spec(spec)
+        self.spec = spec
+        self.seed = seed
+        self.enabled = bool(self.rules)
+        self._by_site: Dict[str, List[FaultRule]] = {}
+        for r in self.rules:
+            self._by_site.setdefault(r.site, []).append(r)
+        self._hits: Dict[str, int] = {}
+        self._injected: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def check(self, site: str) -> Optional[str]:
+        if not self.enabled:
+            return None
+        if site not in SITES:
+            raise ValueError(f"unregistered fault site {site!r}")
+        with self._lock:
+            hit = self._hits.get(site, 0)
+            self._hits[site] = hit + 1
+            fired: Optional[FaultRule] = None
+            for r in self._by_site.get(site, ()):
+                if r.matches(hit, self.seed):
+                    fired = r
+                    break
+            if fired is not None:
+                per = self._injected.setdefault(site, {})
+                per[fired.action] = per.get(fired.action, 0) + 1
+        if fired is None:
+            return None
+        from sparkrdma_tpu.obs.metrics import global_registry
+        from sparkrdma_tpu.obs.timeline import record_active
+        global_registry().counter(f"faults.{site}").inc()
+        record_active("fault:injected", site=site, action=fired.action,
+                      hit=hit)
+        if fired.action == "delay":
+            time.sleep(fired.delay_ms / 1e3)
+            return None
+        return fired.action
+
+    def injected_counts(self) -> Dict[str, Dict[str, int]]:
+        """``{site: {action: n}}`` injections so far (copy)."""
+        with self._lock:
+            return {s: dict(a) for s, a in self._injected.items()}
+
+    def injected_total(self, actions: Tuple[str, ...] = ("fail", "corrupt")
+                       ) -> int:
+        """Total injections of the given actions across all sites."""
+        with self._lock:
+            return sum(a.get(k, 0) for a in self._injected.values()
+                       for k in actions)
+
+    def sites_hit(self) -> List[str]:
+        """Sites with at least one injection (any action), sorted."""
+        with self._lock:
+            return sorted(s for s, a in self._injected.items()
+                          if sum(a.values()) > 0)
+
+
+#: A permanently-disabled plane: ``fire()`` against it is a no-op.
+NULL_PLANE = FaultPlane("")
+
+_active: FaultPlane = NULL_PLANE
+_active_lock = threading.Lock()
+
+
+def set_active_plane(plane: Optional[FaultPlane]) -> FaultPlane:
+    """Install the process-wide plane (None = NULL_PLANE); returns prev."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, (plane or NULL_PLANE)
+    return prev
+
+
+def active_plane() -> FaultPlane:
+    return _active
+
+
+def fire(site: str) -> Optional[str]:
+    """Consult the active plane at ``site``.
+
+    Returns ``None`` (proceed — possibly after an injected delay),
+    ``"fail"`` (raise your contract error) or ``"corrupt"`` (mangle the
+    payload). The fast path on an inactive plane is one attribute load.
+    """
+    p = _active
+    if not p.enabled:
+        return None
+    return p.check(site)
+
+
+def mangle(data: bytes) -> bytes:
+    """Flip one bit of the first byte — the injected-corruption payload
+    transform (deterministic, so tests can assert what the CRC caught)."""
+    if not data:
+        return data
+    b = bytearray(data)
+    b[0] ^= 0x01
+    return bytes(b)
+
+
+# --- degradation / recovery accounting (process-wide, like spill_count) --
+
+_acct_lock = threading.Lock()
+_degradations: Dict[str, int] = {}
+_recoveries: Dict[str, int] = {}
+
+
+def note_degradation(name: str, reason: str = "") -> None:
+    """Record a sticky graceful degradation (e.g. ``serde_native`` →
+    numpy, ``transport`` → xla). Counted once per occurrence; the set of
+    ever-degraded names lands in each journal span's ``degraded`` field.
+    """
+    with _acct_lock:
+        _degradations[name] = _degradations.get(name, 0) + 1
+    from sparkrdma_tpu.obs.metrics import global_registry
+    from sparkrdma_tpu.obs.timeline import record_active
+    global_registry().counter(f"degrade.{name}").inc()
+    record_active("fault:degraded", path=name, reason=reason[:120])
+
+
+def note_recovery(name: str) -> None:
+    """Record a successful in-place recovery (re-read after a CRC
+    mismatch, re-write after a spill failure, checkpoint resume, ...)."""
+    with _acct_lock:
+        _recoveries[name] = _recoveries.get(name, 0) + 1
+    from sparkrdma_tpu.obs.metrics import global_registry
+    from sparkrdma_tpu.obs.timeline import record_active
+    global_registry().counter(f"recover.{name}").inc()
+    record_active("fault:recovered", path=name)
+
+
+def active_degradations() -> List[str]:
+    """Sorted names of every degradation taken so far in this process."""
+    with _acct_lock:
+        return sorted(_degradations)
+
+
+def degradation_total() -> int:
+    with _acct_lock:
+        return sum(_degradations.values())
+
+
+def recovery_total() -> int:
+    with _acct_lock:
+        return sum(_recoveries.values())
+
+
+def recovery_counts() -> Dict[str, int]:
+    with _acct_lock:
+        return dict(_recoveries)
+
+
+def reset_accounting() -> None:
+    """Clear degradation/recovery tallies (tests and soak legs only —
+    sticky fallbacks themselves, e.g. the serde native disable, are NOT
+    reverted here; see their owning modules' reset hooks)."""
+    with _acct_lock:
+        _degradations.clear()
+        _recoveries.clear()
+
+
+# --- retry backoff (shared by the FetchFailedError loop) ----------------
+
+def backoff_ms(attempt: int, base_ms: float, span_id: int = 0,
+               cap_ms: float = 10_000.0) -> float:
+    """Exponential backoff with deterministic jitter for retry ``attempt``
+    (1-based): ``base * 2^(attempt-1)``, jittered into ``[0.5x, 1.0x)``
+    by splitmix64 of (span_id, attempt) — every host computes the same
+    schedule for the same span, so multi-host retries stay reproducible
+    without coordination. Capped at ``cap_ms``."""
+    if base_ms <= 0:
+        return 0.0
+    raw = min(base_ms * (2.0 ** max(attempt - 1, 0)), cap_ms)
+    frac = _mix64((span_id << 8) ^ attempt) / float(1 << 64)
+    return raw * (0.5 + 0.5 * frac)
+
+
+__all__ = ["SITES", "CORRUPTIBLE", "FaultRule", "FaultPlane", "NULL_PLANE",
+           "parse_fault_spec", "set_active_plane", "active_plane", "fire",
+           "mangle", "note_degradation", "note_recovery",
+           "active_degradations", "degradation_total", "recovery_total",
+           "recovery_counts", "reset_accounting", "backoff_ms"]

@@ -86,6 +86,58 @@ _CODEC_MAGIC = b"SRZC"
 _CODEC_IDS = {"zlib": 1, "lzma": 2}
 _HDR = struct.Struct("<4sBQ")        # magic, codec id, raw nbytes
 
+# ---------------------------------------------------------------------
+# CRC32 integrity trailer (chaos-plane round)
+#
+# Spill blobs and checkpoint shards used to carry a magic header but no
+# integrity check: a bit flipped on disk decoded into garbage records.
+# Every file written here now ends with an 8-byte trailer — magic +
+# CRC32 of everything before it — appended to the byte stream BEFORE the
+# writer runs, so the native (sr_write_file / spooler) and numpy
+# (tofile) paths stay bit-identical. Readers auto-detect: a file of
+# exactly the expected payload size is a legacy (pre-trailer) file and
+# reads as before; payload + 8 bytes with the trailer magic verifies the
+# CRC and maps a mismatch onto read_array's documented OSError contract.
+# ---------------------------------------------------------------------
+
+_CRC_MAGIC = b"SRC1"
+_CRC_TRAILER = struct.Struct("<4sI")  # magic, crc32 of preceding bytes
+
+
+def _as_u8(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of a contiguous array (no copy)."""
+    return np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+
+
+def crc_frame(arr: np.ndarray) -> np.ndarray:
+    """``payload + CRC32 trailer`` as one contiguous uint8 buffer.
+
+    One copy of the payload — the price of handing a single buffer to
+    the (async) native writers so both write paths emit identical bytes.
+    """
+    import zlib
+
+    flat = _as_u8(arr)
+    trailer = np.frombuffer(
+        _CRC_TRAILER.pack(_CRC_MAGIC, zlib.crc32(flat) & 0xFFFFFFFF),
+        np.uint8)
+    return np.concatenate([flat, trailer])
+
+
+def verify_crc(payload: np.ndarray, trailer: bytes, path: str) -> None:
+    """Check an 8-byte trailer against the payload; OSError on mismatch."""
+    import zlib
+
+    magic, crc = _CRC_TRAILER.unpack(trailer)
+    if magic != _CRC_MAGIC:
+        raise OSError(f"spill file {path}: trailing bytes are not a CRC "
+                      "trailer — truncated or corrupt")
+    actual = zlib.crc32(_as_u8(payload)) & 0xFFFFFFFF
+    if actual != crc:
+        raise OSError(
+            f"spill file {path} failed CRC32 verification (stored "
+            f"{crc:#010x}, computed {actual:#010x}) — corrupt")
+
 
 def compress_array(arr: np.ndarray, codec: str, level: int = 1) -> bytes:
     """Header + compressed bytes of a contiguous array."""
@@ -368,6 +420,27 @@ class HostBufferPool:
         self._free.clear()
 
 
+def _fire_spill_write(path: str) -> bool:
+    """Consult the fault plane at ``spill.write``; True = corrupt payload.
+
+    An injected transient write failure is retried once in place
+    (counted as a ``spill_rewrite`` recovery — the transient-IO
+    hardening rung); a persistent one raises the writer's OSError
+    contract instead of looping.
+    """
+    from sparkrdma_tpu import faults as _faults
+
+    act = _faults.fire("spill.write")
+    if act == "fail":
+        act = _faults.fire("spill.write")   # one bounded in-place retry
+        if act == "fail":
+            raise OSError(
+                f"injected fault (spill.write): write of {path} failed "
+                "twice — giving up")
+        _faults.note_recovery("spill_rewrite")
+    return act == "corrupt"
+
+
 class SpillWriter:
     """Pipelined spill-to-disk: submit arrays, keep computing, drain once.
 
@@ -378,7 +451,7 @@ class SpillWriter:
     """
 
     def __init__(self, depth: int = 8, use_native: bool = True,
-                 codec: str = "", level: int = 1):
+                 codec: str = "", level: int = 1, checksum: bool = True):
         # codec != "": every submitted array is compressed (header +
         # blob, see compress_array). Compression runs synchronously in
         # submit() — zlib releases the GIL but the caller still waits;
@@ -387,6 +460,7 @@ class SpillWriter:
             raise ValueError(f"unknown compression codec {codec!r}")
         self._codec = codec
         self._level = level
+        self._checksum = checksum
         self._lib = load_native() if use_native else None
         self._pending: List[np.ndarray] = []  # keep-alive until drain
         if self._lib is not None:
@@ -416,9 +490,17 @@ class SpillWriter:
 
     def submit(self, path: str, arr: np.ndarray) -> None:
         _count_spill(arr.nbytes)
+        corrupt = _fire_spill_write(path)
         if self._codec:
             arr = np.frombuffer(
                 compress_array(arr, self._codec, self._level), np.uint8)
+        if self._checksum:
+            arr = crc_frame(arr)
+            if corrupt:
+                # storage-corruption injection: the trailer holds the
+                # TRUE payload's CRC, the payload is mangled — exactly
+                # what a bit flip after the write would look like
+                arr[0] ^= 0x01
         arr = np.ascontiguousarray(arr)
         self._pending.append(arr)  # keep alive
         if self._handle is not None:
@@ -457,11 +539,18 @@ class SpillWriter:
 
 
 def write_array(path: str, arr: np.ndarray, use_native: bool = True,
-                codec: str = "", level: int = 1) -> None:
-    """Synchronous single-array spill (optionally compressed)."""
+                codec: str = "", level: int = 1,
+                checksum: bool = True) -> None:
+    """Synchronous single-array spill (optionally compressed), ending in
+    a CRC32 trailer (``checksum=False`` reproduces the legacy layout)."""
     _count_spill(arr.nbytes)
+    corrupt = _fire_spill_write(path)
     if codec:
         arr = np.frombuffer(compress_array(arr, codec, level), np.uint8)
+    if checksum:
+        arr = crc_frame(arr)
+        if corrupt:
+            arr[0] ^= 0x01   # see SpillWriter.submit
     arr = np.ascontiguousarray(arr)
     lib = load_native() if use_native else None
     if lib is not None:
@@ -484,7 +573,14 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
     that merely STARTS with the magic falls through to the raw path
     via the header's raw-size field disagreeing.
     """
+    from sparkrdma_tpu import faults as _faults
+
+    tsz = _CRC_TRAILER.size
     expected = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    act = _faults.fire("spill.read")
+    if act == "fail":
+        raise OSError(f"injected fault (spill.read): {path}")
+    corrupt = act == "corrupt"
     try:
         actual = os.path.getsize(path)
     except OSError as e:
@@ -495,30 +591,50 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
             magic, cid, raw_n = _HDR.unpack(head)
             if (magic == _CODEC_MAGIC and cid in _CODEC_IDS.values()
                     and raw_n == expected):
-                raw = decompress_blob(head + f.read())
+                data = head + f.read()
+                if (len(data) >= _HDR.size + tsz
+                        and data[-tsz:-tsz + 4] == _CRC_MAGIC):
+                    body = data[:-tsz]
+                    if corrupt:
+                        body = _faults.mangle(body)
+                    verify_crc(np.frombuffer(body, np.uint8),
+                               data[-tsz:], path)
+                    data = body
+                raw = decompress_blob(data)
                 if len(raw) != expected:
                     raise OSError(f"spill file {path} holds {len(raw)} "
                                   f"raw bytes, expected {expected}")
                 return np.frombuffer(raw, dtype=dtype).reshape(shape) \
                     .copy()
-    if actual != expected:
+    has_trailer = actual == expected + tsz
+    if actual != expected and not has_trailer:
         raise OSError(f"spill file {path} is {actual} bytes, expected "
                       f"{expected} raw (and no valid compression "
                       "header) — truncated or corrupt")
     out = np.empty(shape, dtype=dtype)
     lib = load_native() if use_native else None
     if lib is not None:
+        # reads the first out.nbytes bytes — the trailer, when present,
+        # is fetched separately below
         rc = lib.sr_read_file(path.encode(), out.ctypes.data, out.nbytes)
         if rc != out.nbytes:
             raise OSError(f"native read of {path} short: rc={rc}")
     else:
-        data = np.fromfile(path, dtype=dtype)
+        data = np.fromfile(path, dtype=dtype, count=int(np.prod(shape)))
         if data.size != int(np.prod(shape)):
             raise OSError(f"spill file {path} has wrong size")
         out = data.reshape(shape)
+    if has_trailer:
+        with open(path, "rb") as f:
+            f.seek(expected)
+            trailer = f.read(tsz)
+        if corrupt:
+            _as_u8(out)[0] ^= 0x01
+        verify_crc(out, trailer, path)
     return out
 
 
 __all__ = ["HostBufferPool", "HostBuffer", "SpillWriter", "write_array",
            "read_array", "load_native", "codec_available",
-           "compress_array", "decompress_blob", "spill_count"]
+           "compress_array", "decompress_blob", "spill_count",
+           "crc_frame", "verify_crc"]

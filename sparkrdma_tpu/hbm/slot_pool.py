@@ -41,6 +41,19 @@ from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.obs.timeline import NULL_TIMELINE
 
 
+def _fire_pool_acquire() -> None:
+    """``pool.acquire`` fault site: a ``delay`` rule sleeps inside the
+    acquire (surfacing as ``wait_s`` on the span's ``pool:acquire``
+    event); a ``fail`` rule raises the retryable fetch error — the pool
+    itself is intact, so the reader's retry loop is the right handler."""
+    from sparkrdma_tpu import faults as _faults
+
+    if _faults.fire("pool.acquire") == "fail":
+        from sparkrdma_tpu.exchange.errors import FetchFailedError
+
+        raise FetchFailedError(-1, "injected fault (pool.acquire)")
+
+
 class Slot:
     """One pooled device buffer of shape ``[capacity, record_words]`` uint32.
 
@@ -168,6 +181,7 @@ class SlotPool:
                 f"max_slot_records {self.conf.max_slot_records}"
             )
         t0 = time.perf_counter()
+        _fire_pool_acquire()
         arr = None
         with self._lock:
             stack = self._free.get((cls, rw))
@@ -218,6 +232,7 @@ class SlotPool:
         """
         key = ("shaped", tuple(shape), jnp.dtype(dtype).name, sharding)
         t0 = time.perf_counter()
+        _fire_pool_acquire()
         arr = None
         with self._lock:
             stack = self._free.get(key)

@@ -39,6 +39,40 @@ _META = "meta.json"
 _RECORDS = "records.u32"
 
 
+def _checked_read(what: str, fn):
+    """Bounded re-reads around a checkpoint data read.
+
+    Fires the ``checkpoint.read`` fault site per attempt. A transient
+    failure — injected, or a real read whose CRC verification fails
+    (torn page, racing writer) but reads clean on a later pass — is
+    retried up to TWICE, so a layered fault (a failed open followed by a
+    one-shot corrupt read) still resolves; each failure overcome is
+    counted as a ``checkpoint_reread`` recovery so the chaos-plane
+    accounting identity (injections == retries + recoveries +
+    degradations) stays exact. A persistent failure re-raises the
+    OSError for the SPI layer to map to ``UnrecoverableShuffleError``.
+    Bounded by construction: detected corruption costs at most two
+    extra reads, never a retry loop.
+    """
+    from sparkrdma_tpu import faults as _faults
+
+    last: Optional[OSError] = None
+    for attempt in (0, 1, 2):
+        try:
+            if _faults.fire("checkpoint.read") == "fail":
+                raise OSError(f"injected fault (checkpoint.read): {what}")
+            out = fn()
+        except OSError as e:
+            last = e
+            log.warning("checkpoint read of %s failed (attempt %d): %s",
+                        what, attempt + 1, e)
+            continue
+        for _ in range(attempt):
+            _faults.note_recovery("checkpoint_reread")
+        return out
+    raise last
+
+
 class MapOutputStore:
     """Directory-backed store: one subdir per shuffle id."""
 
@@ -230,16 +264,17 @@ class MapOutputStore:
 
     def read_shard(self, shuffle_id: int, coord: int,
                    shape) -> np.ndarray:
-        return read_array(str(self._dir(shuffle_id) / f"shard_{coord}.u32"),
-                          np.uint32, tuple(shape),
-                          use_native=self.use_native)
+        p = str(self._dir(shuffle_id) / f"shard_{coord}.u32")
+        return _checked_read(p, lambda: read_array(
+            p, np.uint32, tuple(shape), use_native=self.use_native))
 
     def read_records(self, shuffle_id: int, meta: dict) -> np.ndarray:
         """Records of a NON-sharded checkpoint, given already-loaded
         metadata (avoids re-parsing meta on the resume path)."""
-        return read_array(str(self._dir(shuffle_id) / _RECORDS), np.uint32,
-                          tuple(meta["shape"]),
-                          use_native=self.use_native)
+        p = str(self._dir(shuffle_id) / _RECORDS)
+        return _checked_read(p, lambda: read_array(
+            p, np.uint32, tuple(meta["shape"]),
+            use_native=self.use_native))
 
     def load(self, shuffle_id: int) -> Tuple[np.ndarray, ShufflePlan, int]:
         """Returns ``(records, plan, num_parts)``; KeyError if absent.
@@ -255,9 +290,10 @@ class MapOutputStore:
             raise ValueError(
                 f"shuffle {shuffle_id} is a sharded (multi-host) "
                 "checkpoint; resume via ShuffleManager.resume_shuffle")
-        records = read_array(str(d / _RECORDS), np.uint32,
-                             tuple(meta["shape"]),
-                             use_native=self.use_native)
+        rp = str(d / _RECORDS)
+        records = _checked_read(rp, lambda: read_array(
+            rp, np.uint32, tuple(meta["shape"]),
+            use_native=self.use_native))
         plan = ShufflePlan(
             counts=np.asarray(meta["counts"], dtype=np.int64),
             num_rounds=int(meta["num_rounds"]),

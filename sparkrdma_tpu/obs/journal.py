@@ -87,7 +87,9 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: ``heartbeat`` line kinds (see obs/rollup.py).
 #: v4: + ``serde_encode_bytes``/``serde_encode_s`` and decode twins —
 #: process-cumulative host codec totals (api/serde.py), spill_count-style.
-SCHEMA_VERSION = 4
+#: v5: + ``backoff_ms`` (per-attempt retry backoff delays, ms) and
+#: ``degraded`` (sticky fallback names active at emit — faults.py ladder).
+SCHEMA_VERSION = 5
 
 
 @dataclasses.dataclass
@@ -125,6 +127,13 @@ class ExchangeSpan:
     serde_encode_s: float = 0.0
     serde_decode_bytes: int = 0
     serde_decode_s: float = 0.0
+    # --- recovery hardening (schema v5) ---
+    # per-attempt backoff sleeps (ms) taken by this read's retry loop;
+    # len(backoff_ms) <= retry_count (backoff may be disabled)
+    backoff_ms: List[float] = dataclasses.field(default_factory=list)
+    # sticky degradations active when the span was emitted (e.g.
+    # "serde_native", "transport") — see sparkrdma_tpu/faults.py
+    degraded: List[str] = dataclasses.field(default_factory=list)
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

@@ -254,6 +254,192 @@ def test_sharded_checkpoint_roundtrip(tmp_path, rng):
             m2.runtime.stop()
 
 
+class TestFaultPlaneRecovery:
+    """``fault_spec``-driven injection through the real layer call sites
+    (the chaos plane), not the legacy single-point ``fault_hook``."""
+
+    def test_transient_dispatch_fault_spec_retried(self, rng):
+        from sparkrdma_tpu import faults
+
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=5,
+                           fault_spec="exchange.dispatch:fail@attempt<2")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(40, 8,
+                                        modulo_partitioner(8, key_word=1))
+            x = _write(m, handle, rng)
+            out, totals = m.get_reader(handle).read()
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+            assert m.faults.injected_counts() == {
+                "exchange.dispatch": {"fail": 2}}
+            assert faults.active_plane() is m.faults
+        # stop() uninstalls the plane
+        assert not faults.active_plane().enabled
+
+    def test_streaming_round_fault_retried(self, rng):
+        """A fault INSIDE a streaming chunk (not at dispatch) must ride
+        the same FetchFailedError retry loop; the tally firing at
+        ``exchange.stream_round`` proves the streaming regime ran."""
+        conf = ShuffleConf(slot_records=2, max_rounds=16,
+                           max_rounds_in_flight=1, max_retry_attempts=5,
+                           fault_spec="exchange.stream_round:fail@attempt<1")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(41, 8,
+                                        modulo_partitioner(8, key_word=1))
+            x = _write(m, handle, rng, n_per_dev=32)
+            out, totals = m.get_reader(handle).read()
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+            assert m.faults.injected_counts() == {
+                "exchange.stream_round": {"fail": 1}}
+
+    def test_skew_split_ranged_read_fault_retried(self, rng):
+        """Fault during a ranged read of a skew-split shuffle: the retry
+        must reproduce the same partition bytes the clean read returns
+        (split sub-partition windows survive writer recovery)."""
+        conf = ShuffleConf(slot_records=2, max_rounds=4,
+                           max_retry_attempts=5,
+                           fault_spec="exchange.dispatch:fail@attempt<1")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(42, 8, modulo_partitioner(8))
+            x = rng.integers(1, 2**32, size=(8 * 64, 4), dtype=np.uint32)
+            x[:, 0] = 0                  # everything to partition 0
+            plan = m.get_writer(handle).write(
+                m.runtime.shard_records(x)).stop(True)
+            assert plan.split_factor > 1
+            faulted = m.get_reader(handle).read_partition(0)  # hit 0 fails
+            assert m.faults.injected_counts() == {
+                "exchange.dispatch": {"fail": 1}}
+            clean = m.get_reader(handle).read_partition(0)
+            assert np.array_equal(faulted, clean)
+            assert faulted.shape[0] == x.shape[0]
+
+
+class TestBackoffDeadline:
+    def test_backoff_recorded_in_span(self, tmp_path, rng):
+        """Each retry sleeps and logs its per-attempt delay: journal v5
+        spans carry ``backoff_ms`` with one entry per retry."""
+        import json
+
+        sink = tmp_path / "j.jsonl"
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=5,
+                           retry_backoff_ms=1.0, metrics_sink=str(sink),
+                           fault_spec="exchange.dispatch:fail@attempt<2")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(43, 8,
+                                        modulo_partitioner(8, key_word=1))
+            _write(m, handle, rng)
+            m.get_reader(handle).read()
+        spans = [json.loads(ln) for ln in
+                 sink.read_text().splitlines() if "retry_count" in ln]
+        (span,) = [s for s in spans if s["retry_count"] == 2]
+        assert len(span["backoff_ms"]) == 2
+        # exponential base with jitter in [0.5, 1.0] x base*2^(k-1)
+        assert 0.5 <= span["backoff_ms"][0] <= 1.0
+        assert 1.0 <= span["backoff_ms"][1] <= 2.0
+        assert span["degraded"] == []
+
+    def test_no_backoff_when_disabled(self, rng):
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=5,
+                           fault_spec="exchange.dispatch:fail@attempt<1")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(44, 8,
+                                        modulo_partitioner(8, key_word=1))
+            x = _write(m, handle, rng)
+            out, totals = m.get_reader(handle).read()
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+
+    def test_retry_deadline_terminal(self, rng):
+        """A persistent fault must cost bounded wall-clock: the deadline
+        turns the retry loop terminal well before max_retry_attempts."""
+        conf = ShuffleConf(slot_records=64, max_retry_attempts=100,
+                           retry_backoff_ms=20.0, retry_deadline_s=0.05,
+                           fault_spec="exchange.dispatch:fail")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(45, 8,
+                                        modulo_partitioner(8, key_word=1))
+            _write(m, handle, rng)
+            with pytest.raises(FetchFailedError, match="retry deadline"):
+                m.get_reader(handle).read()
+
+    def test_backoff_ms_deterministic_and_bounded(self):
+        from sparkrdma_tpu import faults
+
+        for attempt in (1, 2, 3, 7):
+            a = faults.backoff_ms(attempt, 4.0, span_id=99)
+            b = faults.backoff_ms(attempt, 4.0, span_id=99)
+            assert a == b                     # deterministic jitter
+            lo = 4.0 * 2 ** (attempt - 1) * 0.5
+            hi = 4.0 * 2 ** (attempt - 1)
+            assert lo <= a <= min(hi, 10_000.0)
+        assert faults.backoff_ms(5, 0.0) == 0.0   # disabled
+        assert faults.backoff_ms(30, 1.0) <= 10_000.0   # capped
+
+
+class TestChecksumCorruption:
+    """CRC32 trailers on spilled/checkpointed arrays: corruption is
+    DETECTED and resolves to auto-recovery or one clean
+    UnrecoverableShuffleError — never silent wrong data, never a
+    retry-forever loop."""
+
+    def test_injected_spill_corruption_autorecovers(self, tmp_path, rng):
+        """Transient corrupt read (one-shot injected bit flip) -> the
+        bounded re-read recovers and books a checkpoint_reread."""
+        from sparkrdma_tpu import faults
+
+        faults.reset_accounting()
+        conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                           spill_dir=str(tmp_path / "c1"),
+                           fault_spec="spill.read:corrupt@attempt<1")
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(50, 8,
+                                        modulo_partitioner(8, key_word=1))
+            x = _write(m, handle, rng)
+            m._writers.clear()           # only the host checkpoint left
+            out, totals = m.get_reader(handle).read()
+            assert int(np.asarray(totals).sum()) == x.shape[0]
+            assert m.faults.injected_counts() == {
+                "spill.read": {"corrupt": 1}}
+        assert faults.recovery_counts().get("checkpoint_reread") == 1
+
+    def test_corrupt_spill_blob_is_unrecoverable(self, tmp_path, rng):
+        """PERSISTENT on-disk corruption (real byte flip in the records
+        blob): CRC catches it on every bounded re-read, and the resume
+        path maps it to one clean UnrecoverableShuffleError."""
+        from sparkrdma_tpu.exchange.errors import UnrecoverableShuffleError
+
+        conf = ShuffleConf(slot_records=64, spill_to_host=True,
+                           spill_dir=str(tmp_path / "c2"))
+        with ShuffleManager(MeshRuntime(conf), conf) as m:
+            handle = m.register_shuffle(51, 8,
+                                        modulo_partitioner(8, key_word=1))
+            _write(m, handle, rng)
+            blob = tmp_path / "c2" / "shuffle_51" / "records.u32"
+            raw = bytearray(blob.read_bytes())
+            raw[16] ^= 0xFF              # flip a data byte, not the trailer
+            blob.write_bytes(bytes(raw))
+            m._writers.clear()           # live map output gone too
+            with pytest.raises(UnrecoverableShuffleError,
+                               match="checkpoint unreadable"):
+                m.get_reader(handle).read()
+
+    def test_corrupt_checkpoint_shard_detected(self, tmp_path, rng):
+        """Sharded layout: a flipped byte in one shard file fails CRC32
+        verification as a clean OSError from the bounded re-read."""
+        from sparkrdma_tpu.exchange.protocol import ShufflePlan
+        from sparkrdma_tpu.meta.checkpoint import MapOutputStore
+
+        store = MapOutputStore(str(tmp_path / "shards"))
+        plan = ShufflePlan(counts=np.ones((8, 8), np.int64), num_rounds=1,
+                           out_capacity=8, capacity=8)
+        shard = rng.integers(0, 2**32, size=(4, 8), dtype=np.uint32)
+        store.save_shards(52, [(0, shard)], plan, 8, (4, 64), 0, 1)
+        f = tmp_path / "shards" / "shuffle_52" / "shard_0.u32"
+        raw = bytearray(f.read_bytes())
+        raw[8] ^= 0x01
+        f.write_bytes(bytes(raw))
+        with pytest.raises(OSError, match="CRC32"):
+            store.read_shard(52, 0, (4, 8))
+
+
 def test_sharded_checkpoint_incomplete_not_resumable(tmp_path, rng):
     """A torn sharded save (missing a process marker) must read as
     absent, not resume half a map output."""

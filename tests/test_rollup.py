@@ -358,6 +358,7 @@ class TestSchemaV2V3:
             "sample_weight",                   # v3: span sampling
             "serde_encode_bytes", "serde_encode_s",   # v4: host codec
             "serde_decode_bytes", "serde_decode_s",
+            "backoff_ms", "degraded",          # v5: recovery hardening
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)
