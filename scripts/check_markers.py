@@ -1,197 +1,72 @@
 #!/usr/bin/env python3
-"""Test-hygiene lint, run at the top of the tier-1 command (ROADMAP.md).
+"""Tier-1 preamble lint — a thin shim over the srlint engine.
 
-Four invariants keep the CPU tier-1 suite honest:
+Historically this file was a 199-line monolith holding four ad-hoc
+checks (test importability, slow markers, journal schema sync, fault
+site sync). Those four now live as registered rules in
+``sparkrdma_tpu/lint`` alongside the newer AST rules (config-key sync,
+counter-name sync, timeline pairing, guarded-by discipline, assert
+safety, never-raise I/O); this shim runs the *full* rule set so the
+tier-1 command from ROADMAP.md keeps working unchanged while enforcing
+everything.
 
-1. **Importability** — every ``tests/test_*.py`` must import cleanly
-   under ``JAX_PLATFORMS=cpu``. A module that dies at import time makes
-   pytest report a collection error; with ``--continue-on-collection-
-   errors`` the rest of the suite still runs and the dead module's tests
-   silently stop counting. This check turns that silent shrinkage into a
-   loud failure listing the module and the exception.
-2. **Slow markers** — any test module that launches worker subprocesses
-   (``tests/mp_worker.py`` or the ``subprocess`` module) must carry at
-   least one ``pytest.mark.slow``, so ``-m 'not slow'`` actually excludes
-   the multi-process tests it promises to exclude.
-3. **Journal schema sync** — every span field the offline CLIs
-   (``scripts/shuffle_report.py``, ``scripts/shuffle_trace.py``,
-   ``scripts/shuffle_top.py``) read via ``s.get("...")`` /
-   ``span.get("...")`` must exist on ``ExchangeSpan``, and every rollup
-   / heartbeat field they read via ``rb.get("...")`` / ``hb.get("...")``
-   must exist in ``obs.rollup.ROLLUP_FIELDS`` / ``HEARTBEAT_FIELDS``.
-   The CLIs are stdlib-only and never import the dataclass or the
-   field sets, so a schema rename would otherwise silently turn their
-   reads into defaults instead of failing.
-4. **Fault-site sync** — every ``faults.fire("<site>")`` call in the
-   package must name a site registered in ``faults.SITES`` (what the
-   ``fault_spec`` parser accepts), and every registered site must have
-   at least one call site — schedules and injection points cannot
-   silently drift apart.
-
-Static checks only read source; the import check executes module tops,
-which for this suite is cheap (heavy work lives inside test bodies).
+Output shape and exit codes are preserved from the original: failures
+go to stderr as ``check_markers: N failure(s)`` followed by one
+``--- [kind] name`` block per failure, exit 1; success prints the
+legacy one-line summary (plus the srlint rule count) and exits 0. Use
+``python scripts/srlint.py`` directly for per-rule selection, JSON
+output, and ``path:line``-anchored findings.
 """
 
 from __future__ import annotations
 
-import importlib.util
 import os
-import re
 import sys
-import traceback
+from collections import OrderedDict
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-TESTS = REPO / "tests"
 
-
-def check_importable(path: Path) -> str:
-    """Import one test module in-process; return an error string or ''."""
-    name = f"_marker_check_{path.stem}"
-    try:
-        spec = importlib.util.spec_from_file_location(name, path)
-        mod = importlib.util.module_from_spec(spec)
-        # conftest defines fixtures, not imports, so plain module exec
-        # reproduces pytest's collection-time import faithfully
-        sys.modules[name] = mod
-        spec.loader.exec_module(mod)
-        return ""
-    except BaseException:
-        return traceback.format_exc(limit=3)
-    finally:
-        sys.modules.pop(name, None)
-
-
-def check_slow_marked(path: Path) -> str:
-    """Subprocess-launching modules must mark slow; '' if compliant."""
-    src = path.read_text(encoding="utf-8")
-    launches = ("mp_worker" in src
-                or "subprocess.Popen" in src or "subprocess.run" in src)
-    if launches and "pytest.mark.slow" not in src:
-        return (f"{path.name} launches subprocesses but has no "
-                "pytest.mark.slow marker — it would run under "
-                "-m 'not slow'")
-    return ""
-
-
-#: CLI scripts whose span-field reads must match the dataclass
-SPAN_READERS = ("shuffle_report.py", "shuffle_trace.py", "shuffle_top.py")
-
-#: span-field access pattern the lint recognizes; by convention the CLIs
-#: bind a span dict to ``s`` or ``span`` before reading fields from it
-SPAN_GET = re.compile(r'\b(?:s|span)\.get\(\s*"([A-Za-z0-9_]+)"')
-
-#: rollup / heartbeat access patterns; by convention the CLIs bind a
-#: rollup dict to ``rb`` and a heartbeat dict to ``hb``
-ROLLUP_GET = re.compile(r'\brb\.get\(\s*"([A-Za-z0-9_]+)"')
-HEARTBEAT_GET = re.compile(r'\bhb\.get\(\s*"([A-Za-z0-9_]+)"')
-
-
-def check_span_schema_sync() -> str:
-    """CLI journal-field reads must exist in the emitting schema; '' if so.
-
-    Spans: ``total_bytes`` (a derived property serialized by ``to_dict``)
-    and ``kind`` (the auxiliary-line tag, absent on spans by design) are
-    allowed on top of the dataclass fields. Rollup and heartbeat lines
-    are checked against the frozen field sets their emitters assert on
-    (``obs.rollup.ROLLUP_FIELDS`` / ``HEARTBEAT_FIELDS``), so emitter
-    and reader drift in either direction fails loudly.
-    """
-    import dataclasses
-
-    from sparkrdma_tpu.obs.journal import ExchangeSpan
-    from sparkrdma_tpu.obs.rollup import HEARTBEAT_FIELDS, ROLLUP_FIELDS
-
-    span_allowed = ({f.name for f in dataclasses.fields(ExchangeSpan)}
-                    | {"total_bytes", "kind"})
-    checks = (
-        (SPAN_GET, span_allowed, "span", "ExchangeSpan"),
-        (ROLLUP_GET, ROLLUP_FIELDS, "rollup", "obs.rollup.ROLLUP_FIELDS"),
-        (HEARTBEAT_GET, HEARTBEAT_FIELDS, "heartbeat",
-         "obs.rollup.HEARTBEAT_FIELDS"),
-    )
-    bad = []
-    for script in SPAN_READERS:
-        src = (REPO / "scripts" / script).read_text(encoding="utf-8")
-        for pattern, allowed, what, where in checks:
-            for m in pattern.finditer(src):
-                if m.group(1) not in allowed:
-                    bad.append(f"scripts/{script} reads {what} field "
-                               f"{m.group(1)!r} which does not exist in "
-                               f"{where} — rename the field or fix the "
-                               "script")
-    return "\n".join(bad)
-
-
-#: fault-site call pattern: ``faults.fire("<site>")`` / ``_faults.fire``
-#: (the single entry point every layer uses to consult the active plane)
-FIRE_CALL = re.compile(r'\b(?:_?faults)\.fire\(\s*"([a-z0-9_.]+)"')
-
-
-def check_fault_site_sync() -> str:
-    """Every ``faults.fire("<site>")`` call in the package must name a
-    registered site, and every registered site must have at least one
-    call site — so the ``fault_spec`` parser never accepts a site name
-    that nothing fires (a schedule written against it would silently
-    inject nothing) and no layer fires an unregistered name (which
-    ``FaultPlane.check`` rejects at runtime, but only when a spec is
-    active). Same style as the span-schema sync lint: source-only scan,
-    conventions pinned by regex.
-    """
-    from sparkrdma_tpu.faults import SITES
-
-    fired: dict[str, list[str]] = {}
-    pkg = REPO / "sparkrdma_tpu"
-    for path in sorted(pkg.rglob("*.py")):
-        if path.name == "faults.py":
-            continue   # the registry itself, not a call site
-        src = path.read_text(encoding="utf-8")
-        for m in FIRE_CALL.finditer(src):
-            fired.setdefault(m.group(1), []).append(
-                str(path.relative_to(REPO)))
-    bad = []
-    for site, where in sorted(fired.items()):
-        if site not in SITES:
-            bad.append(f"{where[0]} fires unregistered fault site "
-                       f"{site!r} — add it to faults.SITES or fix the "
-                       "call")
-    for site in SITES:
-        if site not in fired:
-            bad.append(f"faults.SITES registers {site!r} but no "
-                       "faults.fire(...) call site exists in the package "
-                       "— a fault_spec naming it would inject nothing")
-    return "\n".join(bad)
+#: legacy failure kinds, in the order the original script reported them
+_LEGACY_ORDER = ("slow-marker", "import", "schema-sync", "fault-site-sync")
 
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, str(REPO))
-    failures = []
-    modules = sorted(TESTS.glob("test_*.py"))
+    from sparkrdma_tpu.lint import all_rules, get_rule, run_rules
+
+    modules = sorted((REPO / "tests").glob("test_*.py"))
     if not modules:
         print("check_markers: no test modules found", file=sys.stderr)
         return 1
-    for path in modules:
-        err = check_slow_marked(path)
-        if err:
-            failures.append(("slow-marker", path.name, err))
-        err = check_importable(path)
-        if err:
-            failures.append(("import", path.name, err))
-    err = check_span_schema_sync()
-    if err:
-        failures.append(("schema-sync", "scripts", err))
-    err = check_fault_site_sync()
-    if err:
-        failures.append(("fault-site-sync", "sparkrdma_tpu", err))
-    if failures:
-        print(f"check_markers: {len(failures)} failure(s)", file=sys.stderr)
-        for kind, name, err in failures:
-            print(f"--- [{kind}] {name}\n{err}", file=sys.stderr)
+
+    rules = all_rules()
+    findings = run_rules(REPO)
+
+    # group into legacy-shaped (kind, name) failure blocks
+    blocks: "OrderedDict[tuple, list]" = OrderedDict()
+    legacy_rank = {k: i for i, k in enumerate(_LEGACY_ORDER)}
+    for f in sorted(findings, key=lambda f: (
+            legacy_rank.get(get_rule(f.rule).kind, len(legacy_rank)),
+            f.path, f.line)):
+        kind = get_rule(f.rule).kind
+        name = f.obj or f.path
+        text = (f.message if kind in legacy_rank
+                else (f"line {f.line}: {f.message}" if f.line
+                      else f.message))
+        blocks.setdefault((kind, name), []).append(text)
+
+    if blocks:
+        print(f"check_markers: {len(blocks)} failure(s)", file=sys.stderr)
+        for (kind, name), texts in blocks.items():
+            print(f"--- [{kind}] {name}\n" + "\n".join(texts),
+                  file=sys.stderr)
         return 1
     print(f"check_markers: {len(modules)} test modules importable, "
           "slow markers consistent, CLI span reads schema-synced, "
           "fault sites synced")
+    print(f"srlint: {len(rules)} rules, 0 findings")
     return 0
 
 

@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""srlint CLI — run the repo's static-analysis rules.
+
+Usage:
+    python scripts/srlint.py                 # all rules, human output
+    python scripts/srlint.py --list-rules    # one rule per line
+    python scripts/srlint.py --select a,b    # only the named rules
+    python scripts/srlint.py --json          # machine-readable findings
+
+Exit code 0 when no finding survives suppression, 1 otherwise (2 for
+usage errors such as an unknown rule id). Human output is one
+``path:line: [rule-id] message`` block per finding; ``--json`` emits
+``{"rules": [...], "findings": [...]}``.
+
+The rule set lives in ``sparkrdma_tpu/lint/``; see the package
+docstring there for the suppression syntax and how to add a rule.
+``scripts/check_markers.py`` (the tier-1 preamble) is a thin shim over
+the same engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO))
+    from sparkrdma_tpu.lint import all_rules, run_rules
+
+    ap = argparse.ArgumentParser(
+        prog="srlint", description="static-analysis rules for this repo")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--root", default=str(REPO),
+                    help="repo root to lint (default: this repo)")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        if args.as_json:
+            print(json.dumps({"rules": [
+                {"id": r.id, "doc": r.doc, "kind": r.kind}
+                for r in rules]}, indent=2))
+        else:
+            width = max(len(r.id) for r in rules)
+            for r in rules:
+                print(f"{r.id:<{width}}  {r.doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        known = {r.id for r in rules}
+        unknown = [s for s in select if s not in known]
+        if unknown:
+            print(f"srlint: unknown rule(s): {', '.join(unknown)} "
+                  f"(try --list-rules)", file=sys.stderr)
+            return 2
+
+    findings = run_rules(args.root, select=select)
+    if args.as_json:
+        print(json.dumps({
+            "root": str(args.root),
+            "rules": sorted({r.id for r in rules}
+                            if select is None else select),
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        ran = len(rules) if select is None else len(select)
+        status = "clean" if not findings else f"{len(findings)} finding(s)"
+        print(f"srlint: {ran} rule(s), {status}",
+              file=sys.stderr if findings else sys.stdout)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

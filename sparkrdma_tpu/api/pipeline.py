@@ -62,20 +62,25 @@ _QUEUE_DEPTH = 2
 # pipelined load in the process so chunk buffers recycle across calls
 # (the RdmaBufferManager is one-per-node in the reference too).
 # ---------------------------------------------------------------------
-_pool = None
+_pool = None                        # guarded-by: _pool_lock
 _pool_lock = threading.Lock()
 
 
 def staging_pool():
-    """The process-wide :class:`HostBufferPool` used for chunk staging."""
-    global _pool
-    if _pool is None:
-        from sparkrdma_tpu.hbm.host_staging import HostBufferPool
+    """The process-wide :class:`HostBufferPool` used for chunk staging.
 
-        with _pool_lock:
-            if _pool is None:
-                _pool = HostBufferPool()
-    return _pool
+    The lock is taken unconditionally: the old double-checked fast path
+    read ``_pool`` outside it, which is a data race under free-threaded
+    builds (and a lint violation under guarded-by either way) for a
+    lock that is uncontended after first use.
+    """
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            from sparkrdma_tpu.hbm.host_staging import HostBufferPool
+
+            _pool = HostBufferPool()
+        return _pool
 
 
 def _chunk_rows(conf, n: int, mesh: int,

@@ -294,6 +294,14 @@ class ShuffleConf:
             raise ValueError("key_words must be >=1, val_words >=0")
         if self.max_rounds <= 0 or self.max_rounds_in_flight <= 0:
             raise ValueError("round counts must be positive")
+        if self.queue_depth <= 0:
+            raise ValueError("queue_depth must be positive (it bounds "
+                             "live recv-slot memory)")
+        if self.max_slot_records <= 0:
+            raise ValueError("max_slot_records must be positive")
+        if self.max_retry_attempts <= 0:
+            raise ValueError("max_retry_attempts must be positive (1 = "
+                             "no retries)")
         if self.transport not in ("xla", "pallas_ring", "hierarchical"):
             raise ValueError(f"unknown transport {self.transport!r}")
         if (self.fast_sort_run < 128

@@ -157,6 +157,11 @@ def compress_array(arr: np.ndarray, codec: str, level: int = 1) -> bytes:
 
 def decompress_blob(blob: bytes) -> bytes:
     """Inverse of :func:`compress_array` (returns the raw bytes)."""
+    if len(blob) < _HDR.size:
+        # a truncated file can be shorter than the 13-byte header; keep
+        # the documented OSError contract instead of struct.error
+        raise OSError(f"not a compressed spill blob ({len(blob)} bytes "
+                      "is shorter than the codec header) — truncated")
     magic, cid, raw_n = _HDR.unpack_from(blob)
     if magic != _CODEC_MAGIC:
         raise OSError("not a compressed spill blob (bad magic)")
@@ -191,9 +196,37 @@ def decompress_blob(blob: bytes) -> bytes:
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "build" / "libsparkstaging.so"
 
+#: opt into a sanitizer-instrumented library flavor: "" (default,
+#: plain), "tsan", or "asan". The sanitizer test legs set this in child
+#: processes (the runtime must be LD_PRELOADed before python starts, so
+#: a flavored parent process is not a thing).
+_FLAVOR_ENV = "SPARKRDMA_NATIVE_FLAVOR"
+_FLAVORS = ("", "tsan", "asan")
+
 _lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_attempted = False
+_lib: Optional[ctypes.CDLL] = None      # guarded-by: _lib_lock
+_lib_attempted = False                  # guarded-by: _lib_lock
+
+
+def native_flavor() -> str:
+    """The sanitizer flavor this process is configured for ('' = plain).
+
+    An unknown value degrades to plain with a warning — same philosophy
+    as every other native-path failure here: never take down the job
+    over instrumentation.
+    """
+    flavor = os.environ.get(_FLAVOR_ENV, "").strip()
+    if flavor not in _FLAVORS:
+        log.warning("unknown %s=%r (expected one of %s); using plain "
+                    "library", _FLAVOR_ENV, flavor, "/".join(_FLAVORS[1:]))
+        return ""
+    return flavor
+
+
+def _flavored_lib_path(flavor: str) -> Path:
+    name = (f"libsparkstaging-{flavor}.so" if flavor
+            else "libsparkstaging.so")
+    return _NATIVE_DIR / "build" / name
 
 
 def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -276,12 +309,21 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
-    """Load (building on demand) the staging library; None on failure."""
+    """Load (building on demand) the staging library; None on failure.
+
+    ``SPARKRDMA_NATIVE_FLAVOR=tsan|asan`` switches the whole process to
+    the matching sanitizer-instrumented build
+    (``libsparkstaging-<flavor>.so``, see ``native/Makefile``). One
+    library per process — the flavor is read once on first load and the
+    result cached like the plain path.
+    """
     global _lib, _lib_attempted
     with _lib_lock:
         if _lib is not None or _lib_attempted:
             return _lib
         _lib_attempted = True
+        flavor = native_flavor()
+        lib_path = _flavored_lib_path(flavor)
         try:
             if build_if_missing:
                 # make is incremental: a no-op when the library is
@@ -291,15 +333,15 @@ def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
                 # through to loading whatever prebuilt library exists.
                 try:
                     subprocess.run(
-                        ["make", "-C", str(_NATIVE_DIR)],
+                        ["make", "-C", str(_NATIVE_DIR), flavor or "all"],
                         check=True, capture_output=True, timeout=120,
                     )
                 except (OSError, subprocess.SubprocessError):
-                    if not _LIB_PATH.exists():
+                    if not lib_path.exists():
                         raise
-            if _LIB_PATH.exists():
-                _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
-                log.info("native staging library loaded: %s", _LIB_PATH)
+            if lib_path.exists():
+                _lib = _declare(ctypes.CDLL(str(lib_path)))
+                log.info("native staging library loaded: %s", lib_path)
         except (OSError, subprocess.SubprocessError) as e:
             log.warning("native staging unavailable (%s); numpy fallback", e)
             _lib = None

@@ -109,19 +109,20 @@ class SlotPool:
                  metrics: Optional[MetricsRegistry] = None):
         self.conf = conf or ShuffleConf()
         self.device = device
+        # guarded-by: _lock
         self._free: Dict[Tuple[int, int], List[jax.Array]] = defaultdict(list)
         self._lock = threading.Lock()
         # stats, mirroring RdmaBufferManager's alloc counters
-        self.allocations = 0
-        self.hits = 0
-        self.misses = 0
-        self.preallocated = 0
-        self.donated_dropped = 0
+        self.allocations = 0               # guarded-by: _lock
+        self.hits = 0                      # guarded-by: _lock
+        self.misses = 0                    # guarded-by: _lock
+        self.preallocated = 0              # immutable after __init__
+        self.donated_dropped = 0           # guarded-by: _lock
         # occupancy: buffers handed out and not yet returned. The
         # high-water mark answers "how many slots were live at peak" —
         # the journal's pool-pressure field.
-        self.outstanding = 0
-        self.outstanding_high_water = 0
+        self.outstanding = 0               # guarded-by: _lock
+        self.outstanding_high_water = 0    # guarded-by: _lock
         # null registry keeps the hand-out path branch-free when the
         # manager runs without metrics
         self.metrics = metrics if metrics is not None \
@@ -158,7 +159,9 @@ class SlotPool:
         self.timeline.counter("pool.outstanding", out)
 
     def _alloc(self, capacity: int, record_words: int) -> jax.Array:
-        self.allocations += 1
+        # callers (get / __init__) invoke this with _lock released
+        with self._lock:
+            self.allocations += 1
         arr = jnp.zeros((capacity, record_words), dtype=jnp.uint32)
         if self.device is not None:
             arr = jax.device_put(arr, self.device)
@@ -194,11 +197,13 @@ class SlotPool:
                 self.donated_dropped += 1
         hit = arr is not None
         if arr is None:
-            self.misses += 1
+            with self._lock:
+                self.misses += 1
             self.metrics.counter("pool.misses").inc()
             arr = self._alloc(cls, rw)
         else:
-            self.hits += 1
+            with self._lock:
+                self.hits += 1
             self.metrics.counter("pool.hits").inc()
         self.timeline.event("pool:acquire", hit=hit,
                             wait_s=round(time.perf_counter() - t0, 6))
@@ -210,7 +215,8 @@ class SlotPool:
         # A slot whose array was donated into a jitted step is dead; returning
         # it would hand a deleted buffer to the next get().
         if slot.array.is_deleted():
-            self.donated_dropped += 1
+            with self._lock:
+                self.donated_dropped += 1
             return
         with self._lock:
             self._free[(slot.capacity, slot.record_words)].append(slot.array)
@@ -244,8 +250,9 @@ class SlotPool:
                 self.donated_dropped += 1
         hit = arr is not None
         if arr is None:
-            self.misses += 1
-            self.allocations += 1
+            with self._lock:
+                self.misses += 1
+                self.allocations += 1
             self.metrics.counter("pool.misses").inc()
             if sharding is not None:
                 arr = jax.jit(
@@ -256,7 +263,8 @@ class SlotPool:
                 if self.device is not None:
                     arr = jax.device_put(arr, self.device)
         else:
-            self.hits += 1
+            with self._lock:
+                self.hits += 1
             self.metrics.counter("pool.hits").inc()
         # the acquire "wait": a miss pays device alloc + zero-fill
         # dispatch, a hit only the stack pop — the difference is the
@@ -275,7 +283,8 @@ class SlotPool:
         """
         self._track_in()
         if arr.is_deleted():
-            self.donated_dropped += 1
+            with self._lock:
+                self.donated_dropped += 1
             return
         key = ("shaped", tuple(arr.shape), arr.dtype.name, sharding)
         with self._lock:
@@ -291,15 +300,16 @@ class SlotPool:
             self._free.clear()
 
     def stats(self) -> Dict[str, int]:
-        return {
-            "allocations": self.allocations,
-            "hits": self.hits,
-            "misses": self.misses,
-            "preallocated": self.preallocated,
-            "donated_dropped": self.donated_dropped,
-            "outstanding": self.outstanding,
-            "outstanding_high_water": self.outstanding_high_water,
-        }
+        with self._lock:
+            return {
+                "allocations": self.allocations,
+                "hits": self.hits,
+                "misses": self.misses,
+                "preallocated": self.preallocated,
+                "donated_dropped": self.donated_dropped,
+                "outstanding": self.outstanding,
+                "outstanding_high_water": self.outstanding_high_water,
+            }
 
 
 __all__ = ["Slot", "SlotPool"]

@@ -1,0 +1,44 @@
+"""srlint — the repo's pluggable static-analysis framework.
+
+Grown out of ``scripts/check_markers.py`` (now a thin shim over this
+package): one AST-aware engine, a rule registry, per-rule suppression
+comments, and fixture-tested rules. The contracts enforced here are the
+stringly-typed ones correctness quietly became load-bearing on across
+PRs 1-5 — metrics counter names, timeline B/E event pairs,
+``ShuffleConf`` keys, journal schemas, fault sites — plus thread-safety
+discipline (``# guarded-by:`` annotations) and exception contracts
+(``# never-raises`` paths).
+
+Entry points:
+
+- ``python scripts/srlint.py`` — the CLI (``--list-rules`` /
+  ``--select`` / ``--json``), run in the tier-1 preamble via the
+  ``check_markers.py`` shim;
+- :func:`sparkrdma_tpu.lint.run_rules` — run programmatically against
+  any repo root (the fixture tests in ``tests/test_lint.py`` point it
+  at synthetic mini-repos).
+
+Suppression: append ``# srlint: ignore[rule-id]`` (comma-separate for
+several rules) to the flagged line, or put it on a comment line directly
+above. Use sparingly and leave a reason next to it — a suppression is a
+claim the rule is wrong *here*, not a mute button.
+
+Adding a rule: write ``@rule("my-rule", "one-line doc")`` over a
+function taking a :class:`~sparkrdma_tpu.lint.core.LintContext` and
+returning a list of :class:`~sparkrdma_tpu.lint.core.Finding`, import
+the module below so registration runs, and add a failing fixture to
+``tests/test_lint.py`` proving the rule can fire.
+"""
+
+from sparkrdma_tpu.lint.core import (Finding, LintContext, Rule,
+                                     all_rules, get_rule, rule,
+                                     run_rules)
+
+# importing the rule modules registers their rules
+from sparkrdma_tpu.lint import rules_tests    # noqa: F401  (registration)
+from sparkrdma_tpu.lint import rules_sync     # noqa: F401
+from sparkrdma_tpu.lint import rules_timeline  # noqa: F401
+from sparkrdma_tpu.lint import rules_safety   # noqa: F401
+
+__all__ = ["Finding", "LintContext", "Rule", "all_rules", "get_rule",
+           "rule", "run_rules"]

@@ -1,0 +1,457 @@
+"""Contract-sync rules: stringly-typed schemas cross-checked by AST.
+
+Two are ports of the original ``check_markers.py`` lints
+(``journal-schema-sync``, ``fault-site-sync``), re-anchored on AST
+parses of the declaring modules instead of imports so they run against
+fixture mini-repos; two are new (``config-key-sync``,
+``counter-name-sync``). All four share a design rule: the *declaration*
+is parsed out of the source that owns it, never imported, so the lint
+works even when the package can't import.
+
+Every rule here skips quietly when its anchor file is absent — that is
+what lets ``tests/test_lint.py`` exercise one rule at a time against a
+synthetic repo containing only the files that rule reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Dict, List, Optional, Set
+
+from sparkrdma_tpu.lint.core import (Finding, LintContext, SourceFile,
+                                     find_class, module_assign, rule,
+                                     string_elts)
+
+# ---------------------------------------------------------------------
+# journal-schema-sync  (port of check_span_schema_sync)
+# ---------------------------------------------------------------------
+
+#: CLI scripts whose span-field reads must match the dataclass
+SPAN_READERS = ("shuffle_report.py", "shuffle_trace.py", "shuffle_top.py")
+
+#: span-field access pattern the lint recognizes; by convention the CLIs
+#: bind a span dict to ``s`` or ``span`` before reading fields from it
+SPAN_GET = re.compile(r'\b(?:s|span)\.get\(\s*"([A-Za-z0-9_]+)"')
+
+#: rollup / heartbeat access patterns; by convention the CLIs bind a
+#: rollup dict to ``rb`` and a heartbeat dict to ``hb``
+ROLLUP_GET = re.compile(r'\brb\.get\(\s*"([A-Za-z0-9_]+)"')
+HEARTBEAT_GET = re.compile(r'\bhb\.get\(\s*"([A-Za-z0-9_]+)"')
+
+
+def _class_ann_fields(sf: SourceFile, cls_name: str) -> Optional[Set[str]]:
+    """Annotated field names of a (dataclass) class body, or None."""
+    cls = find_class(sf.tree, cls_name)
+    if cls is None:
+        return None
+    return {stmt.target.id for stmt in cls.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)}
+
+
+def _frozen_field_set(sf: SourceFile, name: str) -> Optional[Set[str]]:
+    elts = None
+    node = module_assign(sf.tree, name)
+    if node is not None:
+        elts = string_elts(node)
+    return set(elts) if elts is not None else None
+
+
+@rule("journal-schema-sync",
+      "CLI journal-field reads name real ExchangeSpan/rollup/heartbeat "
+      "fields", kind="schema-sync")
+def check_journal_schema_sync(ctx: LintContext) -> List[Finding]:
+    """Spans: ``total_bytes`` (a derived property serialized by
+    ``to_dict``) and ``kind`` (the auxiliary-line tag) are allowed on
+    top of the dataclass fields, exactly as in the original lint."""
+    checks = []
+    journal = ctx.file("sparkrdma_tpu/obs/journal.py")
+    if journal is not None:
+        span_fields = _class_ann_fields(journal, "ExchangeSpan")
+        if span_fields is not None:
+            checks.append((SPAN_GET, span_fields | {"total_bytes", "kind"},
+                           "span", "ExchangeSpan"))
+    rollup = ctx.file("sparkrdma_tpu/obs/rollup.py")
+    if rollup is not None:
+        for set_name, pattern, what in (
+                ("ROLLUP_FIELDS", ROLLUP_GET, "rollup"),
+                ("HEARTBEAT_FIELDS", HEARTBEAT_GET, "heartbeat")):
+            fields = _frozen_field_set(rollup, set_name)
+            if fields is not None:
+                checks.append((pattern, fields, what,
+                               f"obs.rollup.{set_name}"))
+    findings = []
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            for pattern, allowed, what, where in checks:
+                for m in pattern.finditer(line):
+                    if m.group(1) not in allowed:
+                        findings.append(Finding(
+                            "journal-schema-sync", sf.rel, lineno,
+                            f"scripts/{script} reads {what} field "
+                            f"{m.group(1)!r} which does not exist in "
+                            f"{where} — rename the field or fix the "
+                            "script", obj="scripts"))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# fault-site-sync  (port of check_fault_site_sync)
+# ---------------------------------------------------------------------
+
+#: fault-site call pattern: ``faults.fire("<site>")`` / ``_faults.fire``
+#: (the single entry point every layer uses to consult the active plane)
+FIRE_CALL = re.compile(r'\b(?:_?faults)\.fire\(\s*"([a-z0-9_.]+)"')
+
+
+@rule("fault-site-sync",
+      "faults.fire() call sites and faults.SITES agree both ways",
+      kind="fault-site-sync")
+def check_fault_site_sync(ctx: LintContext) -> List[Finding]:
+    faults = ctx.file("sparkrdma_tpu/faults.py")
+    if faults is None:
+        return []
+    node = module_assign(faults.tree, "SITES")
+    sites = string_elts(node) if node is not None else None
+    if sites is None:
+        return [Finding("fault-site-sync", faults.rel, 0,
+                        "faults.SITES is not a literal tuple of site "
+                        "names — the lint (and the fault_spec parser "
+                        "docs) rely on it being one",
+                        obj="sparkrdma_tpu")]
+    sites_line = (node.lineno if node is not None else 0)
+    fired: Dict[str, List[tuple]] = {}
+    for sf in ctx.package_files():
+        if sf.path.name == "faults.py":
+            continue   # the registry itself, not a call site
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in FIRE_CALL.finditer(line):
+                fired.setdefault(m.group(1), []).append((sf.rel, lineno))
+    findings = []
+    for site, where in sorted(fired.items()):
+        if site not in sites:
+            rel, lineno = where[0]
+            findings.append(Finding(
+                "fault-site-sync", rel, lineno,
+                f"{rel} fires unregistered fault site {site!r} — add it "
+                "to faults.SITES or fix the call", obj="sparkrdma_tpu"))
+    for site in sites:
+        if site not in fired:
+            findings.append(Finding(
+                "fault-site-sync", faults.rel, sites_line,
+                f"faults.SITES registers {site!r} but no "
+                "faults.fire(...) call site exists in the package — a "
+                "fault_spec naming it would inject nothing",
+                obj="sparkrdma_tpu"))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# config-key-sync
+# ---------------------------------------------------------------------
+
+_NUMERIC_ANNOTATIONS = ("int", "float")
+
+
+def _shuffleconf_surface(sf: SourceFile):
+    """(fields, numeric fields, methods/properties, __post_init__ node)
+    parsed out of the ``ShuffleConf`` class body."""
+    cls = find_class(sf.tree, "ShuffleConf")
+    if cls is None:
+        return None
+    fields: Dict[str, int] = {}
+    numeric: Dict[str, int] = {}
+    methods: Set[str] = set()
+    post_init = None
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            fields[stmt.target.id] = stmt.lineno
+            ann = stmt.annotation
+            if isinstance(ann, ast.Name) and ann.id in _NUMERIC_ANNOTATIONS:
+                numeric[stmt.target.id] = stmt.lineno
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.name == "__post_init__":
+                post_init = stmt
+            elif not stmt.name.startswith("__"):
+                methods.add(stmt.name)
+    return fields, numeric, methods, post_init
+
+
+@rule("config-key-sync",
+      "ShuffleConf fields are validated, documented, read somewhere, "
+      "and every conf.<attr> access names a real field")
+def check_config_key_sync(ctx: LintContext) -> List[Finding]:
+    """Convention the rule pins: locals/attributes named ``conf`` /
+    ``_conf`` / ``cfg`` hold a ``ShuffleConf`` — the package uses those
+    names for nothing else, which is what makes accesses checkable."""
+    conf_sf = ctx.file("sparkrdma_tpu/config.py")
+    if conf_sf is None:
+        return []
+    surface = _shuffleconf_surface(conf_sf)
+    if surface is None:
+        return [Finding("config-key-sync", conf_sf.rel, 0,
+                        "config.py defines no ShuffleConf class")]
+    fields, numeric, methods, post_init = surface
+    findings = []
+
+    # (a) numeric fields must be range-checked in __post_init__
+    validated: Set[str] = set()
+    if post_init is not None:
+        for node in ast.walk(post_init):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                validated.add(node.attr)
+    for name, lineno in sorted(numeric.items()):
+        if name not in validated:
+            findings.append(Finding(
+                "config-key-sync", conf_sf.rel, lineno,
+                f"numeric ShuffleConf field {name!r} is never touched "
+                "by __post_init__ — add a range check (a bad value "
+                "should fail at construction, not mid-shuffle)"))
+
+    # (b) every field documented (backticked) in the README config table
+    readme = ctx.file("README.md")
+    if readme is not None:
+        section, header_line = "", 0
+        m = re.search(r"^## Configuration\b.*$", readme.text, re.M)
+        if m:
+            header_line = readme.text[:m.start()].count("\n") + 1
+            rest = readme.text[m.end():]
+            nxt = re.search(r"^## ", rest, re.M)
+            section = rest[:nxt.start()] if nxt else rest
+        for name, _ in sorted(fields.items()):
+            if f"`{name}`" not in section:
+                findings.append(Finding(
+                    "config-key-sync", readme.rel, header_line,
+                    f"ShuffleConf field {name!r} is not documented in "
+                    "the README '## Configuration' section — add a "
+                    "table row (backticked name)"))
+
+    # (c) every field read somewhere in the package; (d) every
+    # conf.<attr> access names a real field/property/method. Reads
+    # inside config.py itself count (fields consumed through derived
+    # properties like prealloc_classes are wired up), but __post_init__
+    # does not — validation alone must not satisfy the "read" check.
+    read: Set[str] = set()
+    conf_receivers = ("conf", "_conf", "cfg")
+    post_init_nodes = ({id(n) for n in ast.walk(post_init)}
+                       if post_init is not None else set())
+    for sf in ctx.package_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if sf.rel == conf_sf.rel and id(node) in post_init_nodes:
+                continue
+            if node.attr in fields:
+                read.add(node.attr)
+            base = node.value
+            is_conf = (isinstance(base, ast.Name)
+                       and base.id in conf_receivers) or \
+                      (isinstance(base, ast.Attribute)
+                       and base.attr in conf_receivers)
+            if is_conf and not node.attr.startswith("__") \
+                    and node.attr not in fields \
+                    and node.attr not in methods:
+                findings.append(Finding(
+                    "config-key-sync", sf.rel, node.lineno,
+                    f"conf.{node.attr} does not name a ShuffleConf "
+                    "field or property — typo, or a field that was "
+                    "removed"))
+    for name, lineno in sorted(fields.items()):
+        if name not in read:
+            findings.append(Finding(
+                "config-key-sync", conf_sf.rel, lineno,
+                f"ShuffleConf field {name!r} is never read anywhere in "
+                "the package — dead knob (delete it or wire it up)"))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# counter-name-sync
+# ---------------------------------------------------------------------
+
+_EMIT_ATTRS = ("counter", "gauge", "histogram")
+
+#: metric-shaped strings the CLI scan considers, e.g. ``pool.hits`` or
+#: ``pool.outstanding (hb)``
+_METRIC_SHAPE = re.compile(r"^[a-z_]+(\.[a-z_]+)+( \(hb\))?$")
+
+#: dotted strings that are filenames, not metric names
+_FILE_SUFFIXES = (".py", ".so", ".cpp", ".md", ".txt", ".log",
+                  ".json", ".jsonl", ".gz")
+
+
+def _declared_names(names_sf: SourceFile):
+    """Parse obs/names.py: per-set name→lineno maps, or None if any of
+    the five declarations is missing/non-literal."""
+    out = {}
+    const_lines = {}
+    for node in ast.walk(names_sf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            const_lines.setdefault(node.value, node.lineno)
+    for set_name in ("COUNTERS", "GAUGES", "HISTOGRAMS",
+                     "TIMELINE_TRACKS", "WILDCARDS"):
+        node = module_assign(names_sf.tree, set_name)
+        elts = string_elts(node) if node is not None else None
+        if elts is None:
+            return None
+        out[set_name] = {e: const_lines.get(e, 0) for e in elts}
+    return out
+
+
+def _name_arg_exprs(call: ast.Call) -> List[ast.AST]:
+    """The expression(s) a call's first argument can evaluate to —
+    unwraps conditional expressions, so
+    ``counter(f"a.{x}" if flag else f"b.{x}")`` yields both arms."""
+    if not call.args:
+        return []
+    out, stack = [], [call.args[0]]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, ast.IfExp):
+            stack.extend((e.body, e.orelse))
+        else:
+            out.append(e)
+    return out
+
+
+def _as_pattern(expr: ast.AST) -> Optional[str]:
+    """An f-string's literal skeleton with ``*`` per hole, else None."""
+    if not isinstance(expr, ast.JoinedStr):
+        return None
+    parts = []
+    for v in expr.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def _docstring_ids(tree: ast.AST) -> Set[int]:
+    """ids of every statement-position string constant (docstrings and
+    bare-string separators) — excluded from the CLI name scan."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Constant):
+            out.add(id(node.value))
+    return out
+
+
+@rule("counter-name-sync",
+      "every emitted metric name is declared in obs/names.py, every "
+      "declared name is emitted, and CLI reads name real metrics")
+def check_counter_name_sync(ctx: LintContext) -> List[Finding]:
+    names_sf = ctx.file("sparkrdma_tpu/obs/names.py")
+    if names_sf is None:
+        return []
+    declared = _declared_names(names_sf)
+    if declared is None:
+        return [Finding("counter-name-sync", names_sf.rel, 0,
+                        "obs/names.py must declare COUNTERS, GAUGES, "
+                        "HISTOGRAMS, TIMELINE_TRACKS and WILDCARDS as "
+                        "literal frozensets of strings")]
+    counters = set(declared["COUNTERS"])
+    gauges = set(declared["GAUGES"])
+    histograms = set(declared["HISTOGRAMS"])
+    tracks = set(declared["TIMELINE_TRACKS"])
+    wildcards = set(declared["WILDCARDS"])
+    allowed_by_kind = {
+        # timeline.counter() tracks share the method name with registry
+        # counters, so the counter kind accepts both namespaces
+        "counter": counters | tracks,
+        "gauge": gauges,
+        "histogram": histograms,
+    }
+    all_declared = counters | gauges | histograms | tracks
+
+    emitted: Set[str] = set()
+    emitted_patterns: Set[str] = set()
+    findings = []
+    for sf in ctx.package_files():
+        if sf.rel == names_sf.rel:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMIT_ATTRS):
+                continue
+            kind = node.func.attr
+            for expr in _name_arg_exprs(node):
+                if isinstance(expr, ast.Constant) \
+                        and isinstance(expr.value, str):
+                    name = expr.value
+                    emitted.add(name)
+                    ok = name in allowed_by_kind[kind] or any(
+                        fnmatch.fnmatchcase(name, w) for w in wildcards)
+                    if not ok:
+                        findings.append(Finding(
+                            "counter-name-sync", sf.rel, node.lineno,
+                            f".{kind}({name!r}) emits a metric name "
+                            "not declared in obs/names.py — add it to "
+                            "the registry or fix the name"))
+                    continue
+                pattern = _as_pattern(expr)
+                if pattern is not None:
+                    emitted_patterns.add(pattern)
+                    if pattern not in wildcards:
+                        findings.append(Finding(
+                            "counter-name-sync", sf.rel, node.lineno,
+                            f".{kind}(f\"...\") matches wildcard shape "
+                            f"{pattern!r} which is not declared in "
+                            "obs/names.py WILDCARDS"))
+                # non-constant, non-fstring names can't be checked
+                # statically
+
+    for name in sorted(all_declared):
+        if name not in emitted:
+            line = (declared["COUNTERS"].get(name)
+                    or declared["GAUGES"].get(name)
+                    or declared["HISTOGRAMS"].get(name)
+                    or declared["TIMELINE_TRACKS"].get(name) or 0)
+            findings.append(Finding(
+                "counter-name-sync", names_sf.rel, line,
+                f"obs/names.py declares {name!r} but nothing in the "
+                "package emits it — stale registry entry"))
+    for pattern in sorted(wildcards):
+        if pattern not in emitted_patterns:
+            findings.append(Finding(
+                "counter-name-sync", names_sf.rel,
+                declared["WILDCARDS"].get(pattern, 0),
+                f"obs/names.py declares wildcard {pattern!r} but no "
+                "f-string emission matches it"))
+
+    # CLI side: dotted metric-name strings the scripts read back must
+    # name something the package actually emits
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        doc_ids = _docstring_ids(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in doc_ids):
+                continue
+            val = node.value
+            if not _METRIC_SHAPE.match(val) \
+                    or val.endswith(_FILE_SUFFIXES):
+                continue
+            base = val[:-5] if val.endswith(" (hb)") else val
+            ok = base in all_declared or any(
+                fnmatch.fnmatchcase(base, w) for w in wildcards)
+            if not ok:
+                findings.append(Finding(
+                    "counter-name-sync", sf.rel, node.lineno,
+                    f"scripts/{script} reads metric {base!r} which is "
+                    "not declared in obs/names.py — it would render as "
+                    "zero forever"))
+    return findings

@@ -1,0 +1,113 @@
+"""Timeline B/E pairing: every ``begin`` has an ``end`` on the same
+code path.
+
+Chrome-trace duration events nest by (name, B/E) discipline; an
+unmatched ``B`` leaves a span open forever in Perfetto and skews the
+stall watchdog's notion of "in flight". The rule walks each function
+body and requires that every constant-named begin emission — via
+``.begin("x")``, ``.event("x", ph="B")``, or ``record_active("x",
+ph="B")`` — has a matching end emission in the *same function at the
+same loop depth* (a ``B`` inside a loop whose ``E`` is outside fires
+once per iteration but closes once — a real pairing bug, so the rule
+tracks the chain of enclosing loops, not just the function).
+
+Variable-named emissions (like the timeline API's own internals) are
+invisible to the rule; the convention the repo actually uses is
+constant names at call sites, which is exactly what it checks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from sparkrdma_tpu.lint.core import (Finding, LintContext, SourceFile,
+                                     call_str_arg, rule)
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(ph, name) for a timeline emission with a constant name, else
+    None. ``ph`` is only ever "B" or "E" — instants don't pair."""
+    name = call_str_arg(call)
+    if name is None:
+        return None
+    f = call.func
+    attr = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if attr == "begin":
+        return ("B", name)
+    if attr == "end":
+        return ("E", name)
+    if attr in ("event", "record_active"):
+        for kw in call.keywords:
+            if kw.arg == "ph" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value in ("B", "E"):
+                return (kw.value.value, name)
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                and call.args[1].value in ("B", "E"):
+            return (call.args[1].value, name)
+    return None
+
+
+def _scan_scope(scope_name: str, body, sf: SourceFile,
+                findings: List[Finding]) -> None:
+    """Check one function (or module) body; nested defs recurse as
+    their own scopes — a begin in a closure can't be closed by the
+    enclosing function, they run at different times."""
+    begins = {}   # (loop_chain, name) -> first lineno
+    ends = set()  # (loop_chain, name)
+    nested = []
+
+    def visit(node, chain):
+        if isinstance(node, _DEFS):
+            nested.append(node)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            hit = _classify(node)
+            if hit is not None:
+                ph, name = hit
+                if ph == "B":
+                    begins.setdefault((chain, name), node.lineno)
+                else:
+                    ends.add((chain, name))
+        if isinstance(node, _LOOPS):
+            inner = chain + (node.lineno,)
+            for stmt in node.body:
+                visit(stmt, inner)
+            for stmt in node.orelse:
+                visit(stmt, chain)
+            header = node.test if isinstance(node, ast.While) else node.iter
+            visit(header, chain)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, chain)
+
+    for stmt in body:
+        visit(stmt, ())
+    for (chain, name), lineno in sorted(begins.items(),
+                                        key=lambda kv: kv[1]):
+        if (chain, name) not in ends:
+            where = (f"loop at line {chain[-1]} of {scope_name}"
+                     if chain else scope_name)
+            findings.append(Finding(
+                "timeline-pairing", sf.rel, lineno,
+                f"timeline begin {name!r} in {where} has no matching "
+                "end at the same loop depth — the span never closes"))
+    for fn in nested:
+        _scan_scope(f"{scope_name}.{fn.name}" if scope_name != "<module>"
+                    else fn.name, fn.body, sf, findings)
+
+
+@rule("timeline-pairing",
+      "every timeline begin emission has a matching end in the same "
+      "function and loop")
+def check_timeline_pairing(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.package_files():
+        _scan_scope("<module>", sf.tree.body, sf, findings)
+    return findings

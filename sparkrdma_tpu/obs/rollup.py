@@ -127,14 +127,15 @@ class RollupAggregator:
         self.process_index = process_index
         self._clock = clock
         self._lock = threading.Lock()
-        self._window_start: Optional[float] = None
-        self._cells: Dict[int, _Cell] = {}
-        self._last_spill = 0          # spill_count is process-cumulative
+        self._window_start: Optional[float] = None   # guarded-by: _lock
+        self._cells: Dict[int, _Cell] = {}           # guarded-by: _lock
+        # spill_count is process-cumulative
+        self._last_spill = 0                         # guarded-by: _lock
         # serde codec totals are process-cumulative too (schema v4);
         # windows carry the delta, same trick as spills
-        self._last_serde = (0, 0.0, 0, 0.0)
+        self._last_serde = (0, 0.0, 0, 0.0)          # guarded-by: _lock
         #: rollup lines emitted over this aggregator's lifetime
-        self.emitted = 0
+        self.emitted = 0                             # guarded-by: _lock
 
     def observe(self, span: ExchangeSpan, kept: bool = True,
                 now: Optional[float] = None) -> None:
@@ -237,14 +238,17 @@ class RollupAggregator:
                     LATENCY_BOUNDS_MS, c.lat_buckets, 0.99,
                     hi=c.lat_max_ms), 3),
             }
-            assert set(d) == ROLLUP_FIELDS, sorted(
-                set(d) ^ ROLLUP_FIELDS)
+            if set(d) != ROLLUP_FIELDS:
+                # must survive python -O: the CLIs key on these fields
+                raise RuntimeError(
+                    "rollup line drifted from ROLLUP_FIELDS: "
+                    f"{sorted(set(d) ^ ROLLUP_FIELDS)}")
             self._journal.emit_raw(d)
             self.emitted += 1
         self._cells.clear()
 
 
-def rss_mb() -> Optional[float]:
+def rss_mb() -> Optional[float]:   # never-raises
     """Resident set size in MiB, or None where unavailable.
 
     Prefers ``/proc/self/status`` (current RSS); falls back to
@@ -313,7 +317,7 @@ class HeartbeatEmitter:
         except Exception:
             return -1
 
-    def beat(self, now: Optional[float] = None) -> None:
+    def beat(self, now: Optional[float] = None) -> None:   # never-raises
         try:
             now = self._clock() if now is None else now
             self.seq += 1
@@ -334,8 +338,11 @@ class HeartbeatEmitter:
                 "rotations": getattr(self._journal, "rotations", 0),
                 "rss_mb": rss_mb(),
             }
-            assert set(d) == HEARTBEAT_FIELDS, sorted(
-                set(d) ^ HEARTBEAT_FIELDS)
+            if set(d) != HEARTBEAT_FIELDS:
+                # must survive python -O; caught + counted just below
+                raise RuntimeError(
+                    "heartbeat line drifted from HEARTBEAT_FIELDS: "
+                    f"{sorted(set(d) ^ HEARTBEAT_FIELDS)}")
             self._journal.emit_raw(d)
         except Exception:
             # liveness reporting must never take down the process it

@@ -47,7 +47,7 @@ log = logging.getLogger("sparkrdma_tpu.watchdog")
 # process-wide table of currently-armed waits, for the SIGUSR1 dump —
 # every StallWatchdog registers here while armed
 _armed_lock = threading.Lock()
-_armed: Dict[int, Dict] = {}
+_armed: Dict[int, Dict] = {}        # guarded-by: _armed_lock
 _armed_ids = itertools.count(1)
 
 
@@ -65,13 +65,16 @@ class StallWatchdog:
         self.journal = journal
         self.metrics = metrics
         self.timeline = timeline
+        # the timer thread (_fire) and the SPI thread (set_context /
+        # armed) race on the mutable state below
+        self._lock = threading.Lock()
         #: stalls fired over this watchdog's lifetime
-        self.stall_count = 0
+        self.stall_count = 0                       # guarded-by: _lock
         #: state dict of the most recent stall (None = never stalled)
-        self.last_stall: Optional[Dict] = None
+        self.last_stall: Optional[Dict] = None     # guarded-by: _lock
         # per-read context (span id, shuffle id) merged into stall
         # records; the SPI layer refreshes it at the top of each read
-        self._context: Dict = {}
+        self._context: Dict = {}                   # guarded-by: _lock
 
     @property
     def enabled(self) -> bool:
@@ -79,7 +82,8 @@ class StallWatchdog:
 
     def set_context(self, **kw) -> None:
         """Attach per-read identity (span_id, shuffle_id) to stalls."""
-        self._context = dict(kw)
+        with self._lock:
+            self._context = dict(kw)
 
     @contextlib.contextmanager
     def armed(self, desc: str, **state) -> Iterator[None]:
@@ -87,7 +91,8 @@ class StallWatchdog:
         if not self.enabled:
             yield
             return
-        record = dict(self._context)
+        with self._lock:
+            record = dict(self._context)
         record.update(state)
         record["desc"] = desc
         record["armed_at"] = time.time()
@@ -111,8 +116,9 @@ class StallWatchdog:
         stall["elapsed_s"] = round(time.time() - stall.pop("armed_at"),
                                    6)
         stall["ts"] = time.time()
-        self.stall_count += 1
-        self.last_stall = stall
+        with self._lock:
+            self.stall_count += 1
+            self.last_stall = stall
         log.error("shuffle stall: blocked > %.3fs in %s (%s)",
                   self.timeout_s, stall.get("desc"),
                   ", ".join(f"{k}={v}" for k, v in sorted(stall.items())

@@ -1,0 +1,195 @@
+"""Child-process body for the sanitizer test legs.
+
+Run as ``python tests/sanitizer_worker.py {probe|fuzz}`` with
+``SPARKRDMA_NATIVE_FLAVOR=tsan|asan`` set and the matching sanitizer
+runtime LD_PRELOADed — ``tests/test_sanitizers.py`` does both. The
+point of a separate script (deliberately NOT named ``test_*.py``, so
+neither pytest nor the importability lint rule ever executes it) is
+that a sanitizer runtime must be loaded before the process starts;
+an in-process pytest test can never retrofit one.
+
+``probe`` does one tiny pass through every native entry point — it
+answers "does this toolchain/runtime combination work at all" so the
+parent can skip (not fail) on machines without sanitizer runtimes.
+``fuzz`` replays the serde fuzz matrix from ``tests/test_serde.py``
+(thread counts 1/2/8, degenerate batches, error paths, decode-plan
+validation) plus the CRC/decompress corruption paths, which is where
+a data race or heap overflow in ``native/staging.cpp`` would surface.
+
+Exit codes: 0 ok, 3 native codec unavailable (parent skips), anything
+else — including a sanitizer runtime's own failure exit — fails the leg.
+"""
+
+import sys
+import tempfile
+from pathlib import Path
+
+CODEC_UNAVAILABLE = 3
+
+
+def _serde_matrix(serde, np) -> None:
+    """The TestNativeNumpyEquivalence fuzz contract, replayed verbatim:
+    native and numpy codecs must produce bit-identical rows and
+    identical decode output across thread counts and degenerate
+    shapes."""
+    from sparkrdma_tpu.api.serde import decode_bytes_rows, encode_bytes_rows
+
+    for threads in (1, 2, 8):
+        rng = np.random.default_rng(1000 + threads)
+        for _ in range(6):
+            n = int(rng.integers(1, 400))
+            kw = int(rng.integers(1, 4))
+            maxb = int(rng.integers(1, 97))
+            keys = rng.integers(0, 2**32, size=(n, kw), dtype=np.uint32)
+            payloads = [rng.bytes(int(k))
+                        for k in rng.integers(0, maxb + 1, size=n)]
+            payloads[0] = b""
+            payloads[-1] = b"\xff" * maxb
+            nat = encode_bytes_rows(keys, payloads, maxb,
+                                    native=True, threads=threads)
+            ref = encode_bytes_rows(keys, payloads, maxb, native=False)
+            assert (nat == ref).all(), "native/numpy rows diverged"
+            for native in (True, False):
+                k, p = decode_bytes_rows(nat, kw, native=native,
+                                         threads=threads)
+                assert (k == keys).all() and p == payloads
+
+    # zero-row batch
+    keys = np.empty((0, 2), np.uint32)
+    nat = encode_bytes_rows(keys, [], 16, native=True)
+    for native in (True, False):
+        k, p = decode_bytes_rows(nat, 2, native=native)
+        assert k.shape == (0, 2) and p == []
+
+    # error paths: oversize payload (encode) and corrupt length word
+    # (the decode-plan validation) must raise from BOTH codecs without
+    # the native side ever touching out-of-bounds memory
+    keys = np.zeros((3, 2), np.uint32)
+    for native in (True, False):
+        try:
+            encode_bytes_rows(keys, [b"ok", b"x" * 9, b"y" * 9], 8,
+                              native=native)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("oversize payload not rejected")
+    rows = encode_bytes_rows(keys, [b"a", b"bb", b"ccc"], 8)
+    rows[1, 2] = 999
+    for native in (True, False):
+        try:
+            decode_bytes_rows(rows, 2, native=native)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("corrupt length word not rejected")
+
+
+def _staging_fuzz(hs, np) -> None:
+    """Truncated and bit-flipped frames through the spill codec paths:
+    decompress_blob, crc_frame/verify_crc and the native file
+    write/read round trip."""
+    rng = np.random.default_rng(7)
+    arr = rng.integers(0, 2**32, size=(64, 9), dtype=np.uint32)
+
+    for codec in ("zlib", "lzma"):
+        blob = hs.compress_array(arr, codec)
+        assert hs.decompress_blob(blob) == arr.tobytes()
+        for cut in (0, 1, hs._HDR.size - 1, hs._HDR.size,
+                    hs._HDR.size + 1, len(blob) - 1):
+            try:
+                hs.decompress_blob(blob[:cut])
+            except OSError:
+                pass
+            else:
+                raise AssertionError(f"truncation at {cut} not rejected")
+        for flip in (0, 4, hs._HDR.size + 2, len(blob) - 1):
+            bad = bytearray(blob)
+            bad[flip] ^= 0x40
+            try:
+                out = hs.decompress_blob(bytes(bad))
+                # a flip zlib/lzma happens to tolerate must still be
+                # caught by the length check or yield the exact bytes
+                assert out == arr.tobytes()
+            except OSError:
+                pass
+
+    frame = hs.crc_frame(arr)
+    hs.verify_crc(np.frombuffer(frame[:-8].tobytes(), np.uint8),
+                  frame[-8:].tobytes(), "frame")
+    bad = bytearray(frame.tobytes())
+    bad[3] ^= 0x01
+    try:
+        hs.verify_crc(np.frombuffer(bytes(bad[:-8]), np.uint8),
+                      bytes(bad[-8:]), "frame")
+    except OSError:
+        pass
+    else:
+        raise AssertionError("bit flip not caught by CRC")
+
+    with tempfile.TemporaryDirectory() as td:
+        path = str(Path(td) / "spill.bin")
+        hs.write_array(path, arr, use_native=True)
+        back = hs.read_array(path, np.uint32, arr.shape, use_native=True)
+        assert (back == arr).all()
+        data = Path(path).read_bytes()
+        for cut in (0, 5, len(data) - 9, len(data) - 1):
+            Path(path).write_bytes(data[:cut])
+            try:
+                hs.read_array(path, np.uint32, arr.shape, use_native=True)
+            except OSError:
+                pass
+            else:
+                raise AssertionError(f"truncated spill ({cut}B) read OK")
+        bad = bytearray(data)
+        bad[17] ^= 0x80
+        Path(path).write_bytes(bytes(bad))
+        try:
+            hs.read_array(path, np.uint32, arr.shape, use_native=True)
+        except OSError:
+            pass
+        else:
+            raise AssertionError("bit-flipped spill read OK")
+
+
+def main(mode: str) -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+    import numpy as np
+
+    from sparkrdma_tpu.api import serde
+    from sparkrdma_tpu.hbm import host_staging as hs
+
+    if hs.load_native() is None or not serde.native_codec_available():
+        print("sanitizer worker: native codec unavailable", file=sys.stderr)
+        return CODEC_UNAVAILABLE
+
+    if mode == "probe":
+        # one tiny pass through each native entry point
+        keys = np.zeros((4, 2), np.uint32)
+        rows = serde.encode_bytes_rows(keys, [b"", b"a", b"bb", b"ccc"], 8,
+                                       native=True, threads=2)
+        _, p = serde.decode_bytes_rows(rows, 2, native=True, threads=2)
+        assert p == [b"", b"a", b"bb", b"ccc"]
+        with tempfile.TemporaryDirectory() as td:
+            path = str(Path(td) / "probe.bin")
+            arr = np.arange(32, dtype=np.uint32).reshape(8, 4)
+            hs.write_array(path, arr, use_native=True)
+            assert (hs.read_array(path, np.uint32, (8, 4),
+                                  use_native=True) == arr).all()
+        print("sanitizer worker: probe ok "
+              f"(flavor={hs.native_flavor() or 'plain'})")
+        return 0
+
+    if mode == "fuzz":
+        _serde_matrix(serde, np)
+        _staging_fuzz(hs, np)
+        print("sanitizer worker: fuzz ok "
+              f"(flavor={hs.native_flavor() or 'plain'})")
+        return 0
+
+    print(f"unknown mode {mode!r} (expected probe|fuzz)", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "probe"))

@@ -1,0 +1,500 @@
+"""srlint: per-rule good/bad fixtures, suppressions, engine, CLI.
+
+Every rule gets at least one failing fixture (proving it can fire) and
+one clean fixture (proving it doesn't cry wolf), built as synthetic
+mini-repos under ``tmp_path`` — the rules deliberately skip when their
+anchor files are absent, which is what makes one-rule-at-a-time
+fixtures possible. The meta-test at the bottom then pins the real repo
+itself srlint-clean.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from sparkrdma_tpu.lint import Finding, run_rules
+from sparkrdma_tpu.lint import core as lint_core
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def repo(tmp_path, files):
+    """Materialize a {relpath: source} mini-repo and return its root."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return tmp_path
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------
+# ported rules: importability + slow markers
+# ---------------------------------------------------------------------
+
+def test_tests_importable_fires_and_passes(tmp_path):
+    root = repo(tmp_path, {
+        "tests/test_ok.py": "X = 1\n",
+        "tests/test_broken.py": "import no_such_module_xyzzy\n",
+    })
+    got = run_rules(root, select=["tests-importable"])
+    assert rules_of(got) == ["tests-importable"]
+    assert got[0].path == "tests/test_broken.py"
+    assert "no_such_module_xyzzy" in got[0].message
+    (tmp_path / "tests/test_broken.py").write_text("Y = 2\n")
+    assert run_rules(root, select=["tests-importable"]) == []
+
+
+def test_tests_importable_empty_suite_is_a_finding(tmp_path):
+    (tmp_path / "tests").mkdir()
+    got = run_rules(tmp_path, select=["tests-importable"])
+    assert rules_of(got) == ["tests-importable"]
+    assert "no test modules" in got[0].message
+
+
+def test_slow_marker_rule(tmp_path):
+    bad = 'import subprocess\n\ndef test_x():\n    subprocess.run(["true"])\n'
+    root = repo(tmp_path, {"tests/test_proc.py": bad})
+    got = run_rules(root, select=["tests-slow-marker"])
+    assert rules_of(got) == ["tests-slow-marker"]
+    (tmp_path / "tests/test_proc.py").write_text(
+        "import pytest\n" + bad.replace("def test_x",
+                                        "@pytest.mark.slow\ndef test_x"))
+    assert run_rules(root, select=["tests-slow-marker"]) == []
+
+
+# ---------------------------------------------------------------------
+# contract-sync rules
+# ---------------------------------------------------------------------
+
+_JOURNAL = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class ExchangeSpan:
+        shuffle_id: int
+        rounds: int
+"""
+
+_ROLLUP = """
+    ROLLUP_FIELDS = frozenset({"ts", "window_s"})
+    HEARTBEAT_FIELDS = frozenset({"ts", "rss_mb"})
+"""
+
+
+def test_journal_schema_sync(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/journal.py": _JOURNAL,
+        "sparkrdma_tpu/obs/rollup.py": _ROLLUP,
+        "scripts/shuffle_report.py": """
+            def render(s, rb, hb):
+                return (s.get("shuffle_id"), s.get("total_bytes"),
+                        rb.get("ts"), hb.get("rss_mb"))
+        """,
+    })
+    assert run_rules(root, select=["journal-schema-sync"]) == []
+    (tmp_path / "scripts/shuffle_report.py").write_text(textwrap.dedent("""
+        def render(s, rb, hb):
+            return (s.get("ghost_field"), rb.get("zzz"), hb.get("ts"))
+    """))
+    got = run_rules(root, select=["journal-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2 and "ghost_field" in msgs and "zzz" in msgs
+    assert all(f.obj == "scripts" for f in got)
+
+
+def test_fault_site_sync_both_directions(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/faults.py": 'SITES = ("a.b", "c.d")\n',
+        "sparkrdma_tpu/x.py": """
+            def f(_faults):
+                _faults.fire("a.b")
+                _faults.fire("c.d")
+        """,
+    })
+    assert run_rules(root, select=["fault-site-sync"]) == []
+    (tmp_path / "sparkrdma_tpu/x.py").write_text(textwrap.dedent("""
+        def f(_faults):
+            _faults.fire("a.b")
+            _faults.fire("zz.unregistered")
+    """))
+    got = run_rules(root, select=["fault-site-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2                      # unknown fire + unfired site
+    assert "zz.unregistered" in msgs and "'c.d'" in msgs
+
+
+_CONF = """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class ShuffleConf:
+        alpha: int = 4
+        beta: str = "x"
+
+        def __post_init__(self):
+            if self.alpha <= 0:
+                raise ValueError("alpha must be positive")
+"""
+
+_CONF_README = """
+    # demo
+
+    ## Configuration
+
+    | field | meaning |
+    |---|---|
+    | `alpha` | slots |
+    | `beta` | tag |
+
+    ## Next section
+"""
+
+
+def test_config_key_sync_clean(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/config.py": _CONF,
+        "README.md": _CONF_README,
+        "sparkrdma_tpu/use.py": "def f(conf):\n"
+                                "    return conf.alpha + len(conf.beta)\n",
+    })
+    assert run_rules(root, select=["config-key-sync"]) == []
+
+
+@pytest.mark.parametrize("mutation,expect", [
+    # numeric field with no __post_init__ range check
+    (("sparkrdma_tpu/config.py",
+      _CONF.replace('beta: str = "x"',
+                    'beta: str = "x"\n        gamma: int = 1')
+      ), "never touched by __post_init__"),
+    # field missing from the README table
+    (("README.md", _CONF_README.replace("| `beta` | tag |\n", "")),
+     "not documented in the README"),
+    # access to a field that does not exist
+    (("sparkrdma_tpu/use.py",
+      "def f(conf):\n    return conf.alpha + conf.betta\n"),
+     "does not name a ShuffleConf field"),
+    # field never read anywhere
+    (("sparkrdma_tpu/use.py", "def f(conf):\n    return conf.alpha\n"),
+     "never read anywhere"),
+])
+def test_config_key_sync_violations(tmp_path, mutation, expect):
+    files = {
+        "sparkrdma_tpu/config.py": _CONF,
+        "README.md": _CONF_README,
+        "sparkrdma_tpu/use.py": "def f(conf):\n"
+                                "    return conf.alpha + len(conf.beta)\n",
+    }
+    rel, text = mutation
+    files[rel] = text
+    got = run_rules(repo(tmp_path, files), select=["config-key-sync"])
+    assert got, f"expected a finding containing {expect!r}"
+    assert any(expect in f.message for f in got)
+
+
+_NAMES = """
+    COUNTERS = frozenset({"pool.hits"})
+    GAUGES = frozenset({"g.x"})
+    HISTOGRAMS = frozenset({"h.x"})
+    TIMELINE_TRACKS = frozenset({"t.x"})
+    WILDCARDS = frozenset({"w.*"})
+"""
+
+_EMIT = """
+    def emit(reg, tl, op):
+        reg.counter("pool.hits").inc()
+        reg.gauge("g.x").set(1)
+        reg.histogram("h.x").observe(2)
+        tl.counter("t.x", 3)
+        reg.counter(f"w.{op}").inc()
+"""
+
+
+def test_counter_name_sync(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+        "sparkrdma_tpu/m.py": _EMIT,
+    })
+    assert run_rules(root, select=["counter-name-sync"]) == []
+    # an undeclared emission and a stale declaration, both directions
+    (tmp_path / "sparkrdma_tpu/m.py").write_text(textwrap.dedent(
+        _EMIT).replace('reg.counter("pool.hits")',
+                       'reg.counter("rogue.name")'))
+    got = run_rules(root, select=["counter-name-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert "rogue.name" in msgs                 # emitted, not declared
+    assert "'pool.hits'" in msgs                # declared, now unemitted
+
+
+def test_counter_name_sync_fstring_wildcard_and_cli(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+        "sparkrdma_tpu/m.py": _EMIT.replace(
+            'f"w.{op}"', 'f"w.{op}" if op else f"v.{op}"'),
+        "scripts/shuffle_top.py": 'metric = "bogus.metric"\n',
+    })
+    got = run_rules(root, select=["counter-name-sync"])
+    msgs = " | ".join(f.message for f in got)
+    # the IfExp's second arm emits wildcard shape v.* — undeclared
+    assert "'v.*'" in msgs
+    # the CLI reads a metric nothing declares
+    assert "bogus.metric" in msgs
+
+
+# ---------------------------------------------------------------------
+# timeline pairing
+# ---------------------------------------------------------------------
+
+def test_timeline_pairing(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/t.py": """
+        def good(tl):
+            tl.begin("a")
+            tl.end("a")
+
+        def good_record(ci):
+            from x import record_active
+            record_active("d", ph="B", chunk=ci)
+            record_active("d", ph="E", chunk=ci)
+    """})
+    assert run_rules(root, select=["timeline-pairing"]) == []
+    (tmp_path / "sparkrdma_tpu/t.py").write_text(textwrap.dedent("""
+        def loop_bug(tl, items):
+            for it in items:
+                tl.begin("b")
+            tl.end("b")
+
+        def open_span(tl):
+            tl.event("c", ph="B")
+    """))
+    got = run_rules(root, select=["timeline-pairing"])
+    assert len(got) == 2
+    assert "'b'" in got[0].message and "loop at line" in got[0].message
+    assert "'c'" in got[1].message
+
+
+def test_timeline_pairing_nested_defs_are_separate_scopes(tmp_path):
+    # a begin in a closure cannot be closed by the enclosing function
+    root = repo(tmp_path, {"sparkrdma_tpu/t.py": """
+        def outer(tl):
+            def producer():
+                tl.begin("x")
+            tl.end("x")
+    """})
+    got = run_rules(root, select=["timeline-pairing"])
+    assert len(got) == 1 and "'x'" in got[0].message
+
+
+# ---------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------
+
+_GUARDED = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0          # guarded-by: _lock
+
+        def good(self):
+            with self._lock:
+                self.n += 1
+
+        def drain_locked(self):
+            self.n -= 1
+"""
+
+
+def test_guarded_by_clean_and_exemptions(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/g.py": _GUARDED})
+    assert run_rules(root, select=["guarded-by"]) == []
+
+
+def test_guarded_by_fires_outside_lock(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/g.py": _GUARDED + """
+        def bad(self):
+            return self.n
+    """})
+    got = run_rules(root, select=["guarded-by"])
+    assert len(got) == 1
+    assert "self.n" in got[0].message and "'bad'" in got[0].message.replace(
+        "(in bad)", "(in 'bad')")
+
+
+def test_guarded_by_scope_walk_lock_release(tmp_path):
+    # the with-block scope matters: an access after the lock is released
+    # is flagged even though the same method also holds the lock earlier
+    root = repo(tmp_path, {"sparkrdma_tpu/g.py": _GUARDED + """
+        def tricky(self):
+            with self._lock:
+                self.n += 1
+            self.n -= 1
+    """})
+    got = run_rules(root, select=["guarded-by"])
+    assert len(got) == 1 and "tricky" in got[0].message
+    lines = (tmp_path / "sparkrdma_tpu/g.py").read_text().splitlines()
+    assert lines[got[0].line - 1].strip() == "self.n -= 1"
+
+
+def test_guarded_by_module_global(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/g.py": """
+        import threading
+
+        _g_lock = threading.Lock()
+        _g = None       # guarded-by: _g_lock
+
+        def set_g(v):
+            global _g
+            with _g_lock:
+                _g = v
+
+        def bad_read():
+            return _g
+    """})
+    got = run_rules(root, select=["guarded-by"])
+    assert len(got) == 1
+    assert "global _g" in got[0].message and "bad_read" in got[0].message
+
+
+# ---------------------------------------------------------------------
+# assert-safety + suppressions (engine-level behavior rides along)
+# ---------------------------------------------------------------------
+
+def test_assert_safety_fires(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/a.py": "assert 1 == 1\n"})
+    got = run_rules(root, select=["assert-safety"])
+    assert rules_of(got) == ["assert-safety"] and got[0].line == 1
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/a.py": """
+        assert True  # srlint: ignore[assert-safety]
+        # srlint: ignore[assert-safety] -- demo of the line-above form
+        assert True
+        assert False, "this one is NOT suppressed"
+    """})
+    got = run_rules(root, select=["assert-safety"])
+    assert len(got) == 1 and "NOT suppressed" not in got[0].message
+
+
+def test_suppression_is_per_rule(tmp_path):
+    # suppressing one rule must not hide another on the same line
+    root = repo(tmp_path, {"sparkrdma_tpu/a.py": (
+        "assert True  # srlint: ignore[timeline-pairing]\n")})
+    got = run_rules(root, select=["assert-safety"])
+    assert rules_of(got) == ["assert-safety"]
+    # ...and a comma list suppresses each named rule
+    (tmp_path / "sparkrdma_tpu/a.py").write_text(
+        "assert True  # srlint: ignore[timeline-pairing, assert-safety]\n")
+    assert run_rules(root, select=["assert-safety"]) == []
+
+
+# ---------------------------------------------------------------------
+# never-raise-io
+# ---------------------------------------------------------------------
+
+def test_never_raise_io(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/io.py": """
+        def good(path):   # never-raises
+            try:
+                with open(path, "w") as f:
+                    f.write("x")
+            except OSError:
+                pass
+
+        def unannotated(path):
+            with open(path, "w") as f:
+                f.write("x")
+    """})
+    assert run_rules(root, select=["never-raise-io"]) == []
+    (tmp_path / "sparkrdma_tpu/io.py").write_text(textwrap.dedent("""
+        def bad(path):   # never-raises
+            with open(path, "w") as f:
+                f.write("y")
+    """))
+    got = run_rules(root, select=["never-raise-io"])
+    assert len(got) == 2            # the open() and the write()
+    assert all("'bad'" in f.message for f in got)
+
+
+def test_never_raise_io_narrow_handler_does_not_count(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/io.py": """
+        def sneaky(path):   # never-raises
+            try:
+                open(path)
+            except ValueError:
+                pass
+    """})
+    got = run_rules(root, select=["never-raise-io"])
+    assert len(got) == 1 and "sneaky" in got[0].message
+
+
+# ---------------------------------------------------------------------
+# engine: crash reporting, unknown rules, rendering
+# ---------------------------------------------------------------------
+
+def test_crashed_rule_reports_itself(tmp_path):
+    @lint_core.rule("tmp-crash-rule", "always crashes (test only)")
+    def _crash(ctx):
+        raise RuntimeError("boom from test rule")
+    try:
+        got = run_rules(tmp_path, select=["tmp-crash-rule"])
+        assert rules_of(got) == ["tmp-crash-rule"]
+        assert "boom from test rule" in got[0].message
+        assert got[0].path == "<srlint>"
+    finally:
+        lint_core._REGISTRY.pop("tmp-crash-rule")
+
+
+def test_duplicate_rule_id_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        lint_core.rule("assert-safety", "imposter")(lambda ctx: [])
+
+
+def test_unknown_rule_select_raises(tmp_path):
+    with pytest.raises(KeyError, match="unknown srlint rule"):
+        run_rules(tmp_path, select=["no-such-rule"])
+
+
+def test_finding_render_shape():
+    f = Finding("r-id", "pkg/mod.py", 7, "msg")
+    assert f.render() == "pkg/mod.py:7: [r-id] msg"
+    assert Finding("r-id", "pkg", 0, "msg").render() == "pkg: [r-id] msg"
+
+
+# ---------------------------------------------------------------------
+# CLI + the real repo
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_select_json_and_exit_codes(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/a.py": "assert True\n"})
+    cli = [sys.executable, str(REPO / "scripts" / "srlint.py")]
+    res = subprocess.run(
+        cli + ["--root", str(root), "--select", "assert-safety", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1
+    payload = json.loads(res.stdout)
+    assert payload["rules"] == ["assert-safety"]
+    assert [f["rule"] for f in payload["findings"]] == ["assert-safety"]
+    res = subprocess.run(cli + ["--select", "no-such-rule"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 2 and "unknown rule" in res.stderr
+    res = subprocess.run(cli + ["--list-rules"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0
+    assert len(res.stdout.strip().splitlines()) >= 10
+
+
+def test_real_repo_is_srlint_clean():
+    """The meta-test: the repo must stay clean under its own linter —
+    every rule, zero findings (modulo in-source suppressions)."""
+    findings = run_rules(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
