@@ -875,8 +875,63 @@ def suite_ab(a, rng):
         raise SystemExit(f"unknown ab case {case}")
 
 
+def suite_ringfused(a, rng):
+    """A/B the round-8 fused ring transport: full exchange over
+    transport=xla vs pallas_ring unfused (one kernel per round) vs
+    pallas_ring fused (one double-buffered kernel per exchange).
+
+        PROF_RECORDS=8388608 python scripts/profile_sweep.py ringfused
+    """
+    import time as _time
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+
+    n = a.records
+    reps = 8
+
+    def leg(label, transport, ring_fused):
+        conf = ShuffleConf(slot_records=1 << 22, max_slot_records=1 << 23,
+                           transport=transport, ring_fused=ring_fused)
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = manager.runtime.num_partitions
+            x = rng.integers(0, 2**32, size=(mesh * n, conf.record_words),
+                             dtype=np.uint32)
+            records = manager.runtime.shard_records(x)
+            part = hash_partitioner(a.parts * mesh, conf.key_words)
+            handle = manager.register_shuffle(1, a.parts * mesh, part)
+            try:
+                manager.get_writer(handle).write(records).stop(True)
+                reader = manager.get_reader(handle)
+                barrier(reader.read(record_stats=False)[0])  # warm+compile
+                t0 = _time.perf_counter()
+                for _ in range(reps - 1):
+                    reader.read(record_stats=False)
+                out, _ = reader.read(record_stats=False)
+                barrier(out)
+                dt = (_time.perf_counter() - t0) / reps
+            finally:
+                manager.unregister_shuffle(1)
+            gbps = mesh * n * conf.record_words * 4 / dt / 1e9
+            print(f"{label:14s} {dt*1e3:8.2f} ms/exchange = "
+                  f"{gbps:6.2f} GB/s", flush=True)
+            return dt
+        finally:
+            manager.stop()
+
+    t_xla = leg("xla", "xla", True)
+    t_ring = leg("ring", "pallas_ring", False)
+    t_fused = leg("ring_fused", "pallas_ring", True)
+    print(f"ring/xla {t_ring/t_xla:.3f}  ring_fused/xla "
+          f"{t_fused/t_xla:.3f}  ring_fused/ring {t_fused/t_ring:.3f}",
+          flush=True)
+
+
 SUITES = {
     "dispatch": suite_dispatch,
+    "ringfused": suite_ringfused,
     "sortform": suite_sortform,
     "fastsort": suite_fastsort,
     "pipeline": suite_pipeline,

@@ -28,9 +28,10 @@ PARTS = int(os.environ.get("PROF_PARTS", 4))     # partitions per device
 REPEATS = 8
 
 
-def run(transport: str) -> float:
+def run(transport: str, ring_fused: bool = True,
+        label: str = "") -> float:
     conf = ShuffleConf(slot_records=1 << 22, max_slot_records=1 << 23,
-                       transport=transport)
+                       transport=transport, ring_fused=ring_fused)
     manager = ShuffleManager(MeshRuntime(conf), conf)
     try:
         mesh = manager.runtime.num_partitions
@@ -55,7 +56,8 @@ def run(transport: str) -> float:
     finally:
         manager.stop()
     gbps = mesh * N * conf.record_words * 4 / dt / 1e9
-    print(f"{transport:12s} {dt*1e3:8.2f} ms/exchange = {gbps:6.2f} GB/s "
+    name = label or transport
+    print(f"{name:12s} {dt*1e3:8.2f} ms/exchange = {gbps:6.2f} GB/s "
           f"({PARTS} parts/device, {N} rec/device)", flush=True)
     return dt
 
@@ -63,8 +65,13 @@ def run(transport: str) -> float:
 def main():
     print(f"platform={jax.devices()[0].platform}", flush=True)
     xla = run("xla")
-    ring = run("pallas_ring")
+    ring = run("pallas_ring", ring_fused=False, label="ring")
     print(f"ring/xla ratio: {ring / xla:.3f}", flush=True)
+    # the fused multi-round kernel (round 8): double-buffered rounds,
+    # one barrier per exchange, counts on round 0's prefix lane
+    fused = run("pallas_ring", ring_fused=True, label="ring_fused")
+    print(f"ring_fused/xla ratio: {fused / xla:.3f}", flush=True)
+    print(f"ring_fused/ring ratio: {fused / ring:.3f}", flush=True)
     return 0
 
 

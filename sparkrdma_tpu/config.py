@@ -93,6 +93,15 @@ class ShuffleConf:
     #: "hierarchical" = two-stage intra-host (ICI) + inter-host (DCN)
     #: all_to_all (exchange/hierarchical.py, the multi-slice transport)
     transport: str = "xla"
+    #: pallas_ring only: fuse ALL exchange rounds into one multi-round
+    #: kernel (exchange/ring.py make_ring_exchange) — double-buffered
+    #: semaphore banks overlap round r+1's remote DMAs with round r's
+    #: completion, the barrier handshake runs once per exchange, and the
+    #: size exchange rides a prefix lane of round 0's payload. Off =
+    #: one single-round kernel dispatch per round (the pre-round-8
+    #: behaviour; keep as an A/B lever and a fallback if a geometry
+    #: trips the fused lowering).
+    ring_fused: bool = True
     #: host-group count for the hierarchical transport; 0 = auto from the
     #: mesh's process set (devices per host = mesh size / processes)
     hierarchy_hosts: int = 0

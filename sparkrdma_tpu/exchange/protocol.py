@@ -72,6 +72,7 @@ from sparkrdma_tpu.config import (ShuffleConf, size_class,
 from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
                                              compact_segments,
                                              fill_round_slots,
+                                             fill_round_slots_dest_major,
                                              histogram_pids)
 
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
@@ -403,15 +404,45 @@ class ShuffleExchange:
     # ------------------------------------------------------------------
     # transports
     # ------------------------------------------------------------------
-    def _data_a2a(self) -> Callable:
+    def _ring_fused_active(self) -> bool:
+        """Is the fused multi-round ring kernel the dispatch path?"""
+        return (self.transport() == "pallas_ring"
+                and self.conf.ring_fused)
+
+    def _make_ring_exchange(self, num_rounds: int, collective_id: int):
+        """Construct the fused kernel, or ``None`` after degradation.
+
+        Construction failure (pallas import, lowering rejection) walks
+        the same ladder as the per-round transports: sticky fallback to
+        ``xla`` when ``transport_fallback`` allows, re-raise otherwise.
+        The caller falls through to the plain per-round path on None.
+        """
+        try:
+            from sparkrdma_tpu.exchange.ring import make_ring_exchange
+
+            return make_ring_exchange(self.mesh, self.axis_name,
+                                      num_rounds,
+                                      collective_id=collective_id,
+                                      metrics=self.metrics)
+        except Exception as exc:  # degradation ladder (or re-raise)
+            self._degrade_transport(exc)
+            return None
+
+    def _data_a2a(self, collective_id: int = 7) -> Callable:
         """The configured data-round transport: dest-major slot tensor
-        ``[mesh, ...]`` -> source-major received tensor."""
+        ``[mesh, ...]`` -> source-major received tensor.
+
+        ``collective_id`` names the barrier semaphore of the pallas
+        transports; derived per exec-cache key (see
+        :func:`~sparkrdma_tpu.exchange.ring.derive_collective_id`) so
+        concurrent shuffles never share a barrier."""
         ax = self.axis_name
         if self.transport() == "pallas_ring":
             try:
                 from sparkrdma_tpu.exchange.ring import make_ring_all_to_all
 
                 return make_ring_all_to_all(self.mesh, ax,
+                                            collective_id=collective_id,
                                             metrics=self.metrics)
             except Exception as exc:  # degradation ladder (or re-raise)
                 self._degrade_transport(exc)
@@ -532,7 +563,8 @@ class ShuffleExchange:
                     aggregator: str = "",
                     float_payload: bool = False,
                     donate_out: bool = False,
-                    tight_out: bool = False) -> Callable:
+                    tight_out: bool = False,
+                    collective_id: int = 7) -> Callable:
         """``sort_key_words > 0`` fuses the reduce-side key-ordering sort
         into the same compiled program (one dispatch, one XLA schedule —
         the RdmaShuffleReader's ExternalSorter stage inlined).
@@ -547,7 +579,10 @@ class ShuffleExchange:
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
-        data_a2a = self._data_a2a()
+        ring_ex = None
+        if self._ring_fused_active():
+            ring_ex = self._make_ring_exchange(num_rounds, collective_id)
+        data_a2a = self._data_a2a(collective_id)
 
         def local_step(records, *maybe_buf):
             # records: columnar [W, n_local]
@@ -585,38 +620,82 @@ class ShuffleExchange:
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
                 counts, num_parts, mesh_size, ax)          # [mesh, ppd]
-            incoming = lax.all_to_all(
-                dev_counts, ax, split_axis=0, concat_axis=0, tiled=True
-            )                                               # [mesh, ppd]
 
-            # --- data rounds ------------------------------------------
-            recv_rounds = []
-            for r in range(num_rounds):
-                slots, _ = fill_round_slots(
-                    sr, counts, offs, num_parts, capacity, r
-                )                                           # [W, P, C]
-                # group per destination device: [mesh, ppd, W, C]
-                # (partition p = q*mesh + d lives on device d, local q)
-                slots = slots.reshape(record_words, ppd, mesh_size, capacity
-                                      ).transpose(2, 1, 0, 3)
-                # dest-major [mesh, ppd, W, C]: the configured transport
-                # moves row d to device d (xla: lax.all_to_all;
-                # pallas_ring: one-sided remote-DMA descriptors)
-                recv = data_a2a(slots)                      # [mesh, ppd, W, C]
-                recv_rounds.append(recv)
+            if ring_ex is not None:
+                # --- fused data rounds (one kernel, all rounds) -------
+                # dest-major fills: [mesh, ppd, W, C] per round, NO
+                # reshape/transpose staging pass — the stack below is a
+                # leading-axis concat, and the kernel DMAs row d of each
+                # round straight to device d with round r+1 posted while
+                # round r completes (double-buffered semaphore banks).
+                round_slots = [
+                    fill_round_slots_dest_major(
+                        sr, counts, offs, num_parts, mesh_size,
+                        capacity, r)[0]
+                    for r in range(num_rounds)
+                ]
+                slots = jnp.stack(round_slots)  # [R, mesh, ppd, W, C]
+                # the size exchange rides a one-column prefix lane of
+                # round 0's payload instead of a separate all_to_all
+                # serialized ahead of the data: lane[0, d, q] carries
+                # dev_counts[d, q], so the counts land with (not before)
+                # the first payload DMA.
+                lane = jnp.zeros(
+                    (num_rounds, mesh_size, ppd, record_words, 1),
+                    slots.dtype)
+                lane = lane.at[0, :, :, 0, 0].set(
+                    dev_counts.astype(slots.dtype))
+                recv_all = ring_ex(
+                    jnp.concatenate([lane, slots], axis=4)
+                )                           # [R, mesh, ppd, W, C+1]
+                # recv_all[0, s, q, 0, 0] = sender s's dev_counts[my, q]
+                # — exactly all_to_all(dev_counts)[s, q]
+                incoming = recv_all[0, :, :, 0, 0].astype(jnp.int32)
+                data = recv_all[:, :, :, :, 1:]  # [R, mesh, ppd, W, C]
+                # stream order (w; q, s, r, c): axes (r, s, q, w, c) ->
+                # (w, q, s, r, c)
+                stream = data.transpose(3, 2, 1, 0, 4).reshape(
+                    record_words,
+                    ppd * mesh_size * num_rounds * capacity,
+                )
+            else:
+                incoming = lax.all_to_all(
+                    dev_counts, ax, split_axis=0, concat_axis=0,
+                    tiled=True)                             # [mesh, ppd]
 
-            # --- reduce side: concat rounds, compact ------------------
-            # data[s, q, r, :, c] = round r's c-th record from source s
-            # for local partition q. Group the output stream by local
-            # partition first, then source (a reduce task consumes ITS
-            # partition from every map output in map order), then round.
-            # Each (q, s, r) chunk is prefix-valid with length
+                # --- data rounds --------------------------------------
+                recv_rounds = []
+                for r in range(num_rounds):
+                    slots, _ = fill_round_slots(
+                        sr, counts, offs, num_parts, capacity, r
+                    )                                       # [W, P, C]
+                    # group per destination device: [mesh, ppd, W, C]
+                    # (partition p = q*mesh + d lives on device d,
+                    # local q)
+                    slots = slots.reshape(record_words, ppd, mesh_size,
+                                          capacity).transpose(2, 1, 0, 3)
+                    # dest-major [mesh, ppd, W, C]: the configured
+                    # transport moves row d to device d (xla:
+                    # lax.all_to_all; pallas_ring: one-sided remote-DMA
+                    # descriptors)
+                    recv = data_a2a(slots)              # [mesh, ppd, W, C]
+                    recv_rounds.append(recv)
+
+                # data[s, q, r, :, c] = round r's c-th record from
+                # source s for local partition q.
+                data = jnp.stack(recv_rounds,
+                                 axis=2)       # [mesh, ppd, rounds, W, C]
+                stream = data.transpose(3, 1, 0, 2, 4).reshape(
+                    record_words,
+                    ppd * mesh_size * num_rounds * capacity,
+                )
+
+            # --- reduce side: compact the round-chunked stream --------
+            # Group the output stream by local partition first, then
+            # source (a reduce task consumes ITS partition from every
+            # map output in map order), then round. Each (q, s, r)
+            # chunk is prefix-valid with length
             # clip(incoming[s, q] - r*capacity, 0, capacity).
-            data = jnp.stack(recv_rounds, axis=2)  # [mesh, ppd, rounds, W, C]
-            stream = data.transpose(3, 1, 0, 2, 4).reshape(
-                record_words,
-                ppd * mesh_size * num_rounds * capacity,
-            )
             # chunk lengths [ppd*mesh*rounds] in stream order (q, s, r)
             inc = incoming.T.reshape(ppd * mesh_size, 1)    # [q*s, 1]
             r_ix = jnp.arange(num_rounds, dtype=jnp.int32)[None, :]
@@ -685,7 +764,8 @@ class ShuffleExchange:
         ))
 
     def _build_chunk(self, num_parts: int, capacity: int, rounds_per: int,
-                     record_words: int) -> Callable:
+                     record_words: int,
+                     collective_id: int = 7) -> Callable:
         """(bucketed, counts, offsets, r0, recv_buf) -> filled recv_buf.
 
         Runs ``rounds_per`` rounds starting at traced round index ``r0``;
@@ -698,17 +778,34 @@ class ShuffleExchange:
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
-        data_a2a = self._data_a2a()
+        ring_ex = None
+        if self._ring_fused_active():
+            ring_ex = self._make_ring_exchange(rounds_per, collective_id)
+        data_a2a = self._data_a2a(collective_id)
 
         def local_chunk(sr, counts, offs, r0, recv_buf):
-            recvs = []
-            for j in range(rounds_per):
-                slots, _ = fill_round_slots(
-                    sr, counts, offs, num_parts, capacity, r0[0] + j)
-                slots = slots.reshape(record_words, ppd, mesh_size, capacity
-                                      ).transpose(2, 1, 0, 3)
-                recvs.append(data_a2a(slots))       # [mesh, ppd, W, C]
-            chunk = jnp.stack(recvs, axis=0)  # [rounds_per, mesh, ppd, W, C]
+            if ring_ex is not None:
+                # fused: dest-major fills stacked on a leading round
+                # axis (no reshape/transpose staging), all rounds of the
+                # chunk moved by one double-buffered kernel. No counts
+                # lane here — the streaming regime's prep already did
+                # the size exchange.
+                chunk = ring_ex(jnp.stack([
+                    fill_round_slots_dest_major(
+                        sr, counts, offs, num_parts, mesh_size,
+                        capacity, r0[0] + j)[0]
+                    for j in range(rounds_per)
+                ]))                       # [rounds_per, mesh, ppd, W, C]
+            else:
+                recvs = []
+                for j in range(rounds_per):
+                    slots, _ = fill_round_slots(
+                        sr, counts, offs, num_parts, capacity, r0[0] + j)
+                    slots = slots.reshape(record_words, ppd, mesh_size,
+                                          capacity).transpose(2, 1, 0, 3)
+                    recvs.append(data_a2a(slots))   # [mesh, ppd, W, C]
+                chunk = jnp.stack(recvs,
+                                  axis=0)  # [rounds_per, mesh, ppd, W, C]
             return lax.dynamic_update_slice(
                 recv_buf, chunk, (0, 0, 0, 0, 0))
 
@@ -855,10 +952,15 @@ class ShuffleExchange:
                 self._exec_cache[key] = fn
             return fn
 
+        from sparkrdma_tpu.exchange.ring import derive_collective_id
+
         prep = cached(("prep", num_parts, w, pkey),
                       lambda: self._build_prep(num_parts, w, partitioner))
-        chunk_fn = cached(("chunk", num_parts, cap, F, w),
-                          lambda: self._build_chunk(num_parts, cap, F, w))
+        chunk_key = ("chunk", num_parts, cap, F, w)
+        chunk_fn = cached(chunk_key,
+                          lambda: self._build_chunk(
+                              num_parts, cap, F, w,
+                              collective_id=derive_collective_id(chunk_key)))
 
         self.timeline.begin("stream:prep", chunks=n_chunks,
                             rounds=plan.num_rounds)
@@ -927,6 +1029,12 @@ class ShuffleExchange:
             r0 = jnp.full((1,), j * F, jnp.int32)
             recv = chunk_fn(sr, counts, offs, r0, recv_buf)
             tl.event("chunk:dispatch", chunk=j, rounds=F)
+            if self._ring_fused_active():
+                # structural annotations (see exchange()): the chunk's F
+                # rounds run inside one fused kernel
+                for jr in range(F):
+                    tl.begin("ring:round", round=j * F + jr)
+                    tl.end("ring:round", round=j * F + jr)
             fold = cached(
                 ("fold", num_parts, cap, F, total_rounds,
                  plan.out_capacity, w, j == 0),
@@ -1036,14 +1144,25 @@ class ShuffleExchange:
         donate = self.pool is not None
         fn = self._exec_cache.get(key)
         if fn is None:
+            from sparkrdma_tpu.exchange.ring import derive_collective_id
+
             fn = self._build_exec(num_parts, plan.capacity, plan.num_rounds,
                                   plan.out_capacity, w, partitioner,
                                   sort_key_words, aggregator, float_payload,
-                                  donate_out=donate, tight_out=tight)
+                                  donate_out=donate, tight_out=tight,
+                                  collective_id=derive_collective_id(key))
             self._exec_cache[key] = fn
         self.last_dispatches = 1
         m.counter("exchange.dispatches").inc()
         self.timeline.begin("exchange:fused", rounds=plan.num_rounds)
+        if self._ring_fused_active():
+            # structural annotations: the rounds run INSIDE one kernel
+            # (that is the point), so per-round host spans cannot bracket
+            # real device time — they record the round structure the
+            # fused dispatch carries for trace tooling.
+            for r in range(plan.num_rounds):
+                self.timeline.begin("ring:round", round=r)
+                self.timeline.end("ring:round", round=r)
         try:
             if donate:
                 okey = (shuffle_id, key)

@@ -13,7 +13,8 @@ from sparkrdma_tpu.kernels.aggregate import (
     count_by_key,
 )
 from sparkrdma_tpu.kernels.bucketing import (bucket_records, compact_segments,
-                                             fill_round_slots)
+                                             fill_round_slots,
+                                             fill_round_slots_dest_major)
 from sparkrdma_tpu.kernels.sort import (
     compact,
     lexsort_cols,
@@ -24,6 +25,7 @@ from sparkrdma_tpu.kernels.sort import (
 __all__ = [
     "bucket_records",
     "fill_round_slots",
+    "fill_round_slots_dest_major",
     "compact_segments",
     "compact",
     "lexsort_cols",

@@ -192,6 +192,62 @@ def fill_round_slots(
     return slots, send_counts.astype(jnp.int32)
 
 
+def fill_round_slots_dest_major(
+    bucketed: jax.Array,
+    counts: jax.Array,
+    offsets: jax.Array,
+    num_parts: int,
+    mesh_size: int,
+    capacity: int,
+    round_idx,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`fill_round_slots` emitting the transport layout directly.
+
+    Returns ``(slots: uint32[mesh_size, ppd, W, capacity], send_counts:
+    int32[num_parts])`` where ``slots[d, q]`` is the round's window of
+    partition ``p = q * mesh_size + d`` (partition ``p`` lives on device
+    ``p % mesh_size`` — the exchange's round-robin ownership rule).
+
+    Bit-identical to ``fill_round_slots(...)[0].reshape(W, ppd, mesh,
+    C).transpose(2, 1, 0, 3)`` (pinned by tests), but WITHOUT the
+    reshape/transpose pass: the per-partition window reads are issued in
+    destination-major order, so the stacked result already has the
+    ``[mesh, ppd, W, C]`` shape the ring transport DMAs. On the fused
+    pallas-ring path this removes one full HBM round-trip of the slot
+    tensor per exchange round (the staging layout between bucketing and
+    dispatch that ISSUE 8 / ROADMAP item 2 target).
+    """
+    w, n = bucketed.shape
+    ppd = num_parts // mesh_size
+    round_idx = jnp.asarray(round_idx, jnp.int32)
+    c = jnp.arange(capacity, dtype=jnp.int32)
+    send_counts = jnp.clip(counts - round_idx * capacity, 0, capacity)
+    pad = jnp.zeros((w, capacity), bucketed.dtype)
+    # pad so every window is in-bounds (dynamic_slice clamps otherwise,
+    # which would silently shift a window into the previous bucket)
+    padded = jnp.concatenate([bucketed, pad], axis=1)      # [W, N+C]
+    # dest-major flat order t = d*ppd + q reads partition p = q*mesh + d
+    t_ix = jnp.arange(num_parts, dtype=jnp.int32)
+    pids = (t_ix % ppd) * mesh_size + t_ix // ppd
+
+    def window(p):
+        start = offsets[p] + round_idx * capacity
+        win = lax.dynamic_slice(padded, (0, start), (w, capacity))
+        # same per-(p, c) 0/1 mask as fill_round_slots, applied per
+        # window so the masked stack needs no second full-tensor pass
+        return win * (c[None, :] < send_counts[p]).astype(win.dtype)
+
+    if num_parts <= _UNROLL_LIMIT:
+        wins = jnp.stack([window(jnp.int32((t % ppd) * mesh_size + t // ppd))
+                          for t in range(num_parts)], axis=0)
+    else:
+        _, wins = lax.scan(lambda _, p: (None, window(p)), None, pids)
+    # leading-axis reshape only — no transpose, the data is already laid
+    # out dest-major
+    slots = wins.reshape(mesh_size, ppd, w, capacity)
+    return slots, send_counts.astype(jnp.int32)
+
+
 def compact_segments(
     stream: jax.Array, seg_counts: jax.Array, out_capacity: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -239,4 +295,5 @@ def compact_segments(
     return packed, total
 
 
-__all__ = ["bucket_records", "fill_round_slots", "compact_segments"]
+__all__ = ["bucket_records", "fill_round_slots",
+           "fill_round_slots_dest_major", "compact_segments"]

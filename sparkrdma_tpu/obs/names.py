@@ -40,6 +40,9 @@ COUNTERS = frozenset({
     "shuffle.bytes",
     "shuffle.rounds",
     "transport.ring.kernels",
+    "transport.ring.fused_kernels",
+    "transport.ring.fused_rounds",
+    "transport.ring.overlap_rounds",
     "transport.hier.flat_fallbacks",
     "transport.hier.staged_exchanges",
     "watchdog.stalls",

@@ -3,7 +3,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparkrdma_tpu.kernels import (bucket_records, compact_segments,
-                                   fill_round_slots)
+                                   fill_round_slots,
+                                   fill_round_slots_dest_major)
 
 
 def _cols(rows):
@@ -143,3 +144,59 @@ def test_histogram_pids_matches_bincount(rng):
     pids = np.full(100, 3, np.int32)
     got = np.asarray(histogram_pids(jnp.asarray(pids), 8))
     assert got[3] == 100 and got.sum() == 100
+
+
+def _dest_major_golden(rng, num_parts, mesh_size, cap, n=200, w=4):
+    """Pin fill_round_slots_dest_major bit-equal to reshape+transpose of
+    fill_round_slots across every round of a random workload."""
+    ppd = num_parts // mesh_size
+    rows = rng.integers(1, 2**32, size=(n, w), dtype=np.uint32)
+    pids = rng.integers(0, num_parts, size=n).astype(np.int32)
+    sr, counts, offs = bucket_records(_cols(rows), jnp.asarray(pids),
+                                      num_parts)
+    rounds = max(1, int(np.ceil(np.asarray(counts).max() / cap)))
+    for r in range(rounds + 1):          # +1: a past-the-end empty round
+        ref_slots, ref_sc = fill_round_slots(sr, counts, offs,
+                                             num_parts, cap, r)
+        got_slots, got_sc = fill_round_slots_dest_major(
+            sr, counts, offs, num_parts, mesh_size, cap, r)
+        assert got_slots.shape == (mesh_size, ppd, w, cap)
+        exp = np.asarray(ref_slots).reshape(w, ppd, mesh_size, cap
+                                            ).transpose(2, 1, 0, 3)
+        np.testing.assert_array_equal(np.asarray(got_slots), exp)
+        np.testing.assert_array_equal(np.asarray(got_sc),
+                                      np.asarray(ref_sc))
+
+
+def test_fill_round_slots_dest_major_golden_unrolled(rng):
+    """num_parts <= _UNROLL_LIMIT exercises the static-unroll path."""
+    _dest_major_golden(rng, num_parts=12, mesh_size=4, cap=5)
+
+
+def test_fill_round_slots_dest_major_golden_scan(rng):
+    """num_parts > _UNROLL_LIMIT exercises the lax.scan path."""
+    from sparkrdma_tpu.kernels.bucketing import _UNROLL_LIMIT
+
+    assert 24 > _UNROLL_LIMIT
+    _dest_major_golden(rng, num_parts=24, mesh_size=8, cap=4, n=400)
+
+
+def test_fill_round_slots_dest_major_single_device(rng):
+    """mesh_size == 1: dest-major collapses to one device row holding
+    every partition window in partition order."""
+    _dest_major_golden(rng, num_parts=6, mesh_size=1, cap=7, n=90)
+
+
+def test_fill_round_slots_dest_major_jittable(rng):
+    n, p, mesh, cap = 64, 8, 4, 4
+    rows = rng.integers(0, 2**32, size=(n, 3), dtype=np.uint32)
+    pids = jnp.asarray(rng.integers(0, p, size=n).astype(np.int32))
+
+    @jax.jit
+    def step(recs, pids, r):
+        sr, c, o = bucket_records(recs, pids, p)
+        return fill_round_slots_dest_major(sr, c, o, p, mesh, cap, r)
+
+    s0, c0 = step(_cols(rows), pids, 0)
+    assert s0.shape == (mesh, p // mesh, 3, cap)
+    assert int(c0.sum()) <= n

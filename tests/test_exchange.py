@@ -416,3 +416,182 @@ def test_plan_rejects_out_of_range_partitioner(exchange, rng):
     bad_part.cache_key = ("bad", 9)
     with pytest.raises(ValueError, match="out-of-range"):
         ex.plan(records, bad_part, num_parts=8)
+
+
+class TestRingFusedKernel:
+    """The multi-round fused kernel (round 8): ``make_ring_exchange``
+    pinned bit-equal to R independent ``lax.all_to_all`` rounds in
+    interpret mode, plus full-exchange parity for the shapes the
+    acceptance bar names (repartition, terasort, streaming, ragged)."""
+
+    @pytest.mark.parametrize("num_rounds", [1, 2, 5])
+    def test_kernel_parity_vs_all_to_all(self, runtime, rng, num_rounds):
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from sparkrdma_tpu.exchange.ring import (derive_collective_id,
+                                                 make_ring_exchange)
+        from sparkrdma_tpu.utils.compat import shard_map
+
+        rt = runtime
+        mesh_size = rt.num_partitions
+        ex = make_ring_exchange(
+            rt.mesh, rt.axis_name, num_rounds,
+            collective_id=derive_collective_id(("kernel", num_rounds)))
+        g = jnp.asarray(rng.integers(
+            0, 2**32, size=(num_rounds, mesh_size * mesh_size, 3, 5),
+            dtype=np.uint32))
+
+        def ref_fn(s):
+            return jnp.stack([
+                lax.all_to_all(s[r], rt.axis_name, 0, 0, tiled=True)
+                for r in range(num_rounds)])
+
+        sm = dict(mesh=rt.mesh, in_specs=P(None, rt.axis_name),
+                  out_specs=P(None, rt.axis_name), check_vma=False)
+        fused = shard_map(ex, **sm)(g)
+        ref = shard_map(ref_fn, **sm)(g)
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+
+    def test_kernel_single_device_identity(self, rng):
+        import jax
+
+        from sparkrdma_tpu import MeshRuntime
+        from sparkrdma_tpu.exchange.ring import make_ring_exchange
+
+        rt = MeshRuntime(ShuffleConf(slot_records=16),
+                         devices=jax.devices()[:1])
+        try:
+            ex = make_ring_exchange(rt.mesh, rt.axis_name, 3)
+            g = jnp.asarray(rng.integers(0, 2**32, size=(3, 1, 2, 4),
+                                         dtype=np.uint32))
+            np.testing.assert_array_equal(np.asarray(ex(g)), np.asarray(g))
+        finally:
+            rt.stop()
+
+    def test_kernel_rejects_round_mismatch(self, runtime, rng):
+        from sparkrdma_tpu.exchange.ring import make_ring_exchange
+
+        ex = make_ring_exchange(runtime.mesh, runtime.axis_name, 2)
+        bad = jnp.zeros((3, 64, 1, 1), jnp.uint32)
+        with pytest.raises(ValueError, match="fused exchange built for"):
+            ex(bad)
+
+
+class TestRingFusedExchange:
+    """Full-protocol parity: ``pallas_ring`` + ``ring_fused`` (the
+    default) must stay byte-identical to ``transport="xla"``."""
+
+    @pytest.fixture(scope="class")
+    def xla_exchange(self):
+        from sparkrdma_tpu import MeshRuntime
+
+        rt = MeshRuntime(ShuffleConf(slot_records=16,
+                                     max_rounds_in_flight=8))
+        yield ShuffleExchange(rt.mesh, rt.axis_name, rt.conf), rt
+        rt.stop()
+
+    @pytest.fixture(scope="class")
+    def fused_exchange(self):
+        from sparkrdma_tpu import MeshRuntime
+
+        rt = MeshRuntime(ShuffleConf(slot_records=16,
+                                     max_rounds_in_flight=8,
+                                     transport="pallas_ring"))
+        assert rt.conf.ring_fused  # the default: fused is the ring path
+        yield ShuffleExchange(rt.mesh, rt.axis_name, rt.conf), rt
+        rt.stop()
+
+    def test_parity_ragged_multi_round(self, xla_exchange, fused_exchange,
+                                       rng):
+        """Skew forcing several fused-regime rounds with a partially
+        filled (ragged) last round: 40 records into one partition over
+        capacity-16 slots = rounds [16, 16, 8]."""
+        _, rt = xla_exchange
+        x = rng.integers(1, 2**32, size=(8 * 40, 4), dtype=np.uint32)
+        x[:, 0] = 5                       # all -> partition 5
+        xg = rt.shard_records(x)
+        part = modulo_partitioner(8)
+        out_x, tot_x, plan_x = xla_exchange[0].shuffle(xg, part,
+                                                       num_parts=8)
+        out_r, tot_r, plan_r = fused_exchange[0].shuffle(xg, part,
+                                                         num_parts=8)
+        assert plan_r.num_rounds == 3     # ragged: 40 = 16 + 16 + 8
+        assert plan_r.num_rounds <= 8     # fused regime, not streaming
+        np.testing.assert_array_equal(np.asarray(tot_x), np.asarray(tot_r))
+        np.testing.assert_array_equal(np.asarray(out_x), np.asarray(out_r))
+
+    def test_parity_terasort_shape(self, xla_exchange, fused_exchange,
+                                   rng):
+        """The terasort shape: sort_key_words=2 fuses the reduce-side
+        sort into the same program as the fused transport."""
+        _, rt = xla_exchange
+        xg, xn = make_global_records(rng, rt, 48)
+        part = hash_partitioner(8)
+        ex_x, ex_r = xla_exchange[0], fused_exchange[0]
+        plan_x = ex_x.plan(xg, part, num_parts=8)
+        plan_r = ex_r.plan(xg, part, num_parts=8)
+        out_x, tot_x, _ = ex_x.exchange(xg, part, plan_x,
+                                        sort_key_words=2)
+        out_r, tot_r, _ = ex_r.exchange(xg, part, plan_r,
+                                        sort_key_words=2)
+        np.testing.assert_array_equal(np.asarray(tot_x), np.asarray(tot_r))
+        np.testing.assert_array_equal(np.asarray(out_x), np.asarray(out_r))
+
+    def test_fused_counters_and_unfused_parity(self, fused_exchange, rng):
+        """The fused path really ran (trace-time counters moved), and
+        ``ring_fused=False`` (per-round kernels) stays byte-identical."""
+        from sparkrdma_tpu.obs.metrics import MetricsRegistry
+
+        _, rt = fused_exchange
+        reg = MetricsRegistry(enabled=True)
+        ex_f = ShuffleExchange(rt.mesh, rt.axis_name, rt.conf, metrics=reg)
+        xg, xn = make_global_records(rng, rt, 32)
+        part = modulo_partitioner(8)
+        out_f, tot_f, _ = ex_f.shuffle(xg, part, num_parts=8)
+        assert int(reg.counter("transport.ring.fused_kernels").value) >= 1
+        assert int(reg.counter("transport.ring.fused_rounds").value) >= 1
+        conf = ShuffleConf(slot_records=16, max_rounds_in_flight=8,
+                           transport="pallas_ring", ring_fused=False)
+        ex_u = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+        out_u, tot_u, _ = ex_u.shuffle(xg, part, num_parts=8)
+        np.testing.assert_array_equal(np.asarray(tot_f), np.asarray(tot_u))
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_u))
+
+    def test_fused_golden_vs_numpy(self, fused_exchange, rng):
+        """The fused transport independently passes the golden check
+        (repartition shape)."""
+        _, rt = fused_exchange
+        xg, xn = make_global_records(rng, rt, 24)
+        run_and_check(fused_exchange, xg, xn, hash_partitioner(16), 16,
+                      rng)
+
+    def test_parity_streaming_regime(self, rng):
+        """Guaranteed streaming regime (rounds > max_rounds_in_flight):
+        72 skewed records over capacity-16 slots = 5 rounds against
+        F=2, so _build_chunk's fused path runs 3 chunks with a ragged
+        final chunk — byte-identical to the xla transport."""
+        from sparkrdma_tpu import MeshRuntime
+
+        rt = MeshRuntime(ShuffleConf(slot_records=16,
+                                     max_rounds_in_flight=2,
+                                     transport="pallas_ring"))
+        try:
+            ex_r = ShuffleExchange(rt.mesh, rt.axis_name, rt.conf)
+            conf_x = ShuffleConf(slot_records=16, max_rounds_in_flight=2)
+            ex_x = ShuffleExchange(rt.mesh, rt.axis_name, conf_x)
+            x = np.asarray(np.random.default_rng(7).integers(
+                1, 2**32, size=(8 * 72, 4), dtype=np.uint32))
+            x[:, 0] = 5                   # all -> partition 5
+            xg = rt.shard_records(x)
+            part = modulo_partitioner(8)
+            out_x, tot_x, plan_x = ex_x.shuffle(xg, part, num_parts=8)
+            out_r, tot_r, plan_r = ex_r.shuffle(xg, part, num_parts=8)
+            assert plan_r.num_rounds == 5       # 72 = 4*16 + 8 (ragged)
+            assert plan_r.num_rounds > rt.conf.max_rounds_in_flight
+            np.testing.assert_array_equal(np.asarray(tot_x),
+                                          np.asarray(tot_r))
+            np.testing.assert_array_equal(np.asarray(out_x),
+                                          np.asarray(out_r))
+        finally:
+            rt.stop()
