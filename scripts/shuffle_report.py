@@ -140,6 +140,8 @@ def aggregate(spans: List[dict]) -> dict:
     # serde codec totals are PROCESS-CUMULATIVE (schema v4): the true
     # total is the max per process, summed across processes
     serde_by_host: Dict[int, Tuple[float, float, float, float]] = {}
+    # tiered-store totals are process-cumulative too (schema v6)
+    store_by_host: Dict[int, Tuple[int, int, int, int]] = {}
     for s in spans:
         for k in phases:
             phases[k] += float(s.get(k, 0.0))
@@ -163,6 +165,13 @@ def aggregate(spans: List[dict]) -> dict:
         prev = serde_by_host.get(host)
         if prev is None or cum > prev:
             serde_by_host[host] = cum
+        st = (int(s.get("store_spill_bytes", 0) or 0),
+              int(s.get("store_fetch_bytes", 0) or 0),
+              int(s.get("store_prefetch_hits", 0) or 0),
+              int(s.get("store_sync_fetches", 0) or 0))
+        stprev = store_by_host.get(host)
+        if stprev is None or st > stprev:
+            store_by_host[host] = st
         sid = int(s.get("shuffle_id", -1))
         agg = per_shuffle.setdefault(sid, {
             "spans": 0, "records": 0, "rounds": 0,
@@ -218,6 +227,26 @@ def aggregate(spans: List[dict]) -> dict:
         "fabric_mbps": round(total_bytes / exchange_s / 1e6, 3)
         if exchange_s > 0 else 0.0,
     }
+    st_spill = sum(v[0] for v in store_by_host.values())
+    st_fetch = sum(v[1] for v in store_by_host.values())
+    st_hits = sum(v[2] for v in store_by_host.values())
+    st_sync = sum(v[3] for v in store_by_host.values())
+    st_gets = st_hits + st_sync
+    store = {
+        "spill_bytes": st_spill,
+        "fetch_bytes": st_fetch,
+        "prefetch_hits": st_hits,
+        "sync_fetches": st_sync,
+        # overlapped I/O rates over the journal's exchange wall-clock:
+        # the store's writer/prefetcher run WHILE rounds exchange, so
+        # exchange seconds are the window these bytes had to hide in
+        "spill_mbps": round(st_spill / exchange_s / 1e6, 3)
+        if exchange_s > 0 else 0.0,
+        "fetch_mbps": round(st_fetch / exchange_s / 1e6, 3)
+        if exchange_s > 0 else 0.0,
+        "prefetch_hit_rate": round(st_hits / st_gets, 4)
+        if st_gets > 0 else None,
+    }
     return {
         "spans": len(spans),
         "sampling": sampling,
@@ -230,6 +259,7 @@ def aggregate(spans: List[dict]) -> dict:
         "pool_high_water": pool_high_water,
         "spill_count": spills,
         "serde": serde,
+        "store": store,
         "phases": {k: round(v, 6) for k, v in phases.items()},
         "phase_share": {
             k: round(v / wall, 4) if wall > 0 else 0.0
@@ -278,7 +308,11 @@ def aggregate_rollups(rollups: List[dict]) -> dict:
     sums = {"reads": 0, "sampled_reads": 0, "records": 0, "bytes": 0,
             "rounds": 0, "dispatches": 0, "retries": 0, "spills": 0,
             "streaming_reads": 0, "fused_reads": 0,
-            "serde_encode_bytes": 0, "serde_decode_bytes": 0}
+            "serde_encode_bytes": 0, "serde_decode_bytes": 0,
+            # tiered store (v6): windows carry per-window deltas, so a
+            # straight sum is the exact total
+            "store_spill_bytes": 0, "store_fetch_bytes": 0,
+            "store_prefetch_hits": 0, "store_sync_fetches": 0}
     # windows carry (bytes, MB/s); merging recovers the implied seconds
     # so the merged rate stays a proper weighted harmonic mean
     enc_s = dec_s = 0.0
@@ -396,6 +430,31 @@ def host_breakdown(spans: List[dict]) -> dict:
 DOCTOR_SKEW_THRESHOLD = 4.0
 
 
+def _sync_fetch_shuffles(spans: List[dict]) -> Dict[int, int]:
+    """Shuffle ids whose exchanges blocked on synchronous tiered-store
+    fetches, with the blocked-read count attributed to each.
+
+    ``store_sync_fetches`` is process-cumulative, so growth between a
+    host's consecutive spans pins the misses to the span (and shuffle)
+    that paid for them; a nonzero first span inherits everything before
+    it (e.g. the splitter-bootstrap fetch of an out-of-core run)."""
+    by_host: Dict[int, List[dict]] = {}
+    for s in spans:
+        by_host.setdefault(int(s.get("process_index", 0) or 0),
+                           []).append(s)
+    blocked: Dict[int, int] = {}
+    for host_spans in by_host.values():
+        host_spans.sort(key=lambda s: float(s.get("ts", 0.0) or 0.0))
+        prev = 0
+        for s in host_spans:
+            cur = int(s.get("store_sync_fetches", 0) or 0)
+            if cur > prev:
+                sid = int(s.get("shuffle_id", -1))
+                blocked[sid] = blocked.get(sid, 0) + (cur - prev)
+            prev = max(prev, cur)
+    return blocked
+
+
 def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
     """Rule-based symptom -> knob mapping (the --doctor section)."""
     findings: List[str] = []
@@ -433,6 +492,17 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             "native/ with make) and raise serde_threads; the timeline's "
             "serde:encode/serde:h2d events show whether encode or the "
             "host copy is the slow stage")
+    blocked = _sync_fetch_shuffles(spans)
+    if blocked:
+        total = sum(blocked.values())
+        findings.append(
+            f"{total} synchronous tiered-store fetch(es) blocked "
+            f"exchanges in shuffle(s) {sorted(blocked)}: the prefetcher "
+            "missed and a round waited on a disk read — raise "
+            "spill_tier_prefetch (lookahead) and make sure "
+            "spill_tier_host_bytes holds at least lookahead+2 chunks "
+            "(a smaller watermark evicts freshly promoted segments "
+            "right back out), or check disk read bandwidth")
     retried = sorted({int(s.get("shuffle_id", -1)) for s in spans
                       if int(s.get("retry_count", 0)) > 0})
     if retried:
@@ -536,6 +606,18 @@ def print_report(rep: dict, top: int) -> None:
         print(f"  fabric delivered rate over the same spans: "
               f"{sd['fabric_mbps']:,.1f} MB/s "
               f"({_bound_verdict(sd)})")
+    st = rep.get("store") or {}
+    if st.get("spill_bytes") or st.get("fetch_bytes"):
+        hits = st.get("prefetch_hit_rate")
+        hit_str = f"{hits:.1%}" if hits is not None else "n/a"
+        print("tiered store (out-of-core, cumulative, all processes):")
+        print(f"  spilled: {_fmt_bytes(st['spill_bytes'])} "
+              f"({st['spill_mbps']:,.1f} MB/s overlapped)   "
+              f"fetched: {_fmt_bytes(st['fetch_bytes'])} "
+              f"({st['fetch_mbps']:,.1f} MB/s overlapped)")
+        print(f"  prefetch hit rate: {hit_str} "
+              f"({st['prefetch_hits']} hits / "
+              f"{st['sync_fetches']} synchronous fetches)")
     print("per-peer received records (all spans):")
     peers = rep["per_peer_records"]
     total = sum(peers.values()) or 1
@@ -585,6 +667,12 @@ def print_rollups(roll: dict) -> None:
               f"{roll['serde_encode_mbps']:,.1f} MB/s   decode "
               f"{_fmt_bytes(roll['serde_decode_bytes'])} @ "
               f"{roll['serde_decode_mbps']:,.1f} MB/s")
+    if roll.get("store_spill_bytes") or roll.get("store_fetch_bytes"):
+        print(f"  tiered store: spilled "
+              f"{_fmt_bytes(roll['store_spill_bytes'])}, fetched "
+              f"{_fmt_bytes(roll['store_fetch_bytes'])}, "
+              f"{roll['store_prefetch_hits']} prefetch hits / "
+              f"{roll['store_sync_fetches']} synchronous fetches")
     for sid, c in roll["per_shuffle"].items():
         print(f"  shuffle {sid}: {c['reads']:,} reads, "
               f"{c['records']:,} records, {_fmt_bytes(c['bytes'])}, "

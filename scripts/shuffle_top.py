@@ -137,7 +137,9 @@ def _fmt_age(age: Optional[float]) -> str:
 class HostRow:
     __slots__ = ("process_index", "host", "pid", "hb_age", "in_flight",
                  "pool_outstanding", "rss_mb", "reads", "est_reads",
-                 "reads_s", "mb_s", "p95_ms", "spills", "stalls", "stale")
+                 "reads_s", "mb_s", "p95_ms", "spills", "stalls", "stale",
+                 "host_tier_mb", "disk_tier_mb", "spill_mb_s", "fetch_mb_s",
+                 "prefetch_hits", "sync_fetches")
 
     def __init__(self, process_index: int):
         self.process_index = process_index
@@ -155,6 +157,21 @@ class HostRow:
         self.spills = 0
         self.stalls = 0
         self.stale = False
+        # tiered out-of-core store (schema v6): occupancy from heartbeats,
+        # spill/fetch rates + prefetch hit totals from span cumulatives
+        self.host_tier_mb = 0
+        self.disk_tier_mb = 0
+        self.spill_mb_s = 0.0
+        self.fetch_mb_s = 0.0
+        self.prefetch_hits = 0
+        self.sync_fetches = 0
+
+    @property
+    def hit_pct(self) -> Optional[float]:
+        total = self.prefetch_hits + self.sync_fetches
+        if total <= 0:
+            return None
+        return 100.0 * self.prefetch_hits / total
 
 
 def build_host_rows(
@@ -186,12 +203,19 @@ def build_host_rows(
         r.pool_outstanding = int(hb.get("pool_outstanding", 0) or 0)
         rss = hb.get("rss_mb")
         r.rss_mb = float(rss) if isinstance(rss, (int, float)) else None
+        r.host_tier_mb = int(hb.get("host_tier_mb", 0) or 0)
+        r.disk_tier_mb = int(hb.get("disk_tier_mb", 0) or 0)
         r.stale = r.hb_age > stale_s
 
     lat: Dict[int, List[float]] = {}
     recent_bytes: Dict[int, float] = {}
     recent_reads: Dict[int, int] = {}
     max_spill: Dict[int, int] = {}
+    # tiered-store counters are process-cumulative (like spill_count): the
+    # per-process max is the total; min/max over the rate window give rates
+    store_cum: Dict[int, Tuple[int, int, int, int]] = {}
+    store_lo: Dict[int, Tuple[int, int]] = {}
+    store_hi: Dict[int, Tuple[int, int]] = {}
     for s in kinds["span"]:
         pidx = int(s.get("process_index", 0) or 0)
         r = row(pidx)
@@ -201,12 +225,25 @@ def build_host_rows(
         # spill_count is process-cumulative: the newest span carries the total
         max_spill[pidx] = max(max_spill.get(pidx, 0),
                               int(s.get("spill_count", 0) or 0))
+        cum = (int(s.get("store_spill_bytes", 0) or 0),
+               int(s.get("store_fetch_bytes", 0) or 0),
+               int(s.get("store_prefetch_hits", 0) or 0),
+               int(s.get("store_sync_fetches", 0) or 0))
+        if pidx not in store_cum or cum > store_cum[pidx]:
+            store_cum[pidx] = cum
         if float(s.get("ts", 0.0)) >= now - rate_window_s:
             recent_reads[pidx] = recent_reads.get(pidx, 0) + int(
                 s.get("sample_weight", 1) or 1)
             recent_bytes[pidx] = recent_bytes.get(pidx, 0.0) + float(
                 s.get("total_bytes", 0) or 0) * int(
                     s.get("sample_weight", 1) or 1)
+            pair = (cum[0], cum[1])
+            lo = store_lo.get(pidx)
+            store_lo[pidx] = pair if lo is None else (
+                min(lo[0], pair[0]), min(lo[1], pair[1]))
+            hi = store_hi.get(pidx)
+            store_hi[pidx] = pair if hi is None else (
+                max(hi[0], pair[0]), max(hi[1], pair[1]))
     for pidx, vals in lat.items():
         rows[pidx].p95_ms = _p95(vals)
     for pidx, n in recent_reads.items():
@@ -215,6 +252,15 @@ def build_host_rows(
         rows[pidx].mb_s = b / rate_window_s / (1024.0 * 1024.0)
     for pidx, n in max_spill.items():
         rows[pidx].spills = n
+    for pidx, cum in store_cum.items():
+        rows[pidx].prefetch_hits = cum[2]
+        rows[pidx].sync_fetches = cum[3]
+        lo, hi = store_lo.get(pidx), store_hi.get(pidx)
+        if lo is not None and hi is not None:
+            rows[pidx].spill_mb_s = (hi[0] - lo[0]) / rate_window_s / (
+                1024.0 * 1024.0)
+            rows[pidx].fetch_mb_s = (hi[1] - lo[1]) / rate_window_s / (
+                1024.0 * 1024.0)
 
     for st in kinds["stall"]:
         row(int(st.get("process_index", 0) or 0)).stalls += 1
@@ -251,6 +297,7 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
         if sid not in shuffles:
             shuffles[sid] = {"shuffle_id": sid, "reads": 0, "records": 0,
                             "bytes": 0, "spills": 0, "retries": 0,
+                            "sync_fetches": 0,
                             "lat": [], "p95_ms": 0.0, "exact": False}
         return shuffles[sid]
 
@@ -262,6 +309,9 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
         c["bytes"] += int(rb.get("bytes", 0) or 0)
         c["spills"] += int(rb.get("spills", 0) or 0)
         c["retries"] += int(rb.get("retries", 0) or 0)
+        # rollup store fields are per-window deltas: summing windows gives
+        # the shuffle's exact total of exchange-blocking disk reads
+        c["sync_fetches"] += int(rb.get("store_sync_fetches", 0) or 0)
         c["p95_ms"] = max(c["p95_ms"], float(rb.get("p95_ms", 0.0) or 0.0))
 
     for s in kinds["span"]:
@@ -300,27 +350,34 @@ def render(
     lines.append("")
     lines.append(f"{'HOST':>4}  {'NAME':<14} {'PID':>7} {'HB AGE':>7} "
                  f"{'INFL':>4} {'POOL':>4} {'RSS':>8} {'READS/S':>8} "
-                 f"{'MB/S':>8} {'P95MS':>8} {'SPILL':>5} {'STALL':>5}  FLAGS")
+                 f"{'MB/S':>8} {'P95MS':>8} {'SPILL':>5} "
+                 f"{'TIER H/D':>10} {'SPL MB/S':>8} {'FCH MB/S':>8} "
+                 f"{'HIT%':>5} {'STALL':>5}  FLAGS")
     for r in hosts:
         rss = f"{r.rss_mb:.0f}MiB" if r.rss_mb is not None else "-"
         flags = "STALE" if r.stale else ""
+        tier = f"{r.host_tier_mb}/{r.disk_tier_mb}M"
+        hit = f"{r.hit_pct:.0f}" if r.hit_pct is not None else "-"
         lines.append(
             f"{r.process_index:>4}  {r.host[:14]:<14} {r.pid:>7} "
             f"{_fmt_age(r.hb_age):>7} {r.in_flight:>4} "
             f"{r.pool_outstanding:>4} {rss:>8} {r.reads_s:>8.2f} "
             f"{r.mb_s:>8.2f} {r.p95_ms:>8.1f} {r.spills:>5} "
-            f"{r.stalls:>5}  {flags}")
+            f"{tier:>10} {r.spill_mb_s:>8.2f} {r.fetch_mb_s:>8.2f} "
+            f"{hit:>5} {r.stalls:>5}  {flags}")
     if not hosts:
         lines.append("  (no entries yet)")
     lines.append("")
     lines.append(f"{'SHUFFLE':>7}  {'READS':>8} {'RECORDS':>12} "
-                 f"{'BYTES':>10} {'P95MS':>8} {'SPILL':>5} {'RETRY':>5}  SRC")
+                 f"{'BYTES':>10} {'P95MS':>8} {'SPILL':>5} {'RETRY':>5} "
+                 f"{'SYNCF':>5}  SRC")
     for c in shuffles:
         src = "rollup" if c["exact"] else "spans"
         lines.append(
             f"{c['shuffle_id']:>7}  {c['reads']:>8} {c['records']:>12} "
             f"{_fmt_bytes(float(c['bytes'])):>10} {c['p95_ms']:>8.1f} "
-            f"{c['spills']:>5} {c['retries']:>5}  {src}")
+            f"{c['spills']:>5} {c['retries']:>5} "
+            f"{c['sync_fetches']:>5}  {src}")
     return "\n".join(lines)
 
 

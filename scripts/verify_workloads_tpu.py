@@ -163,6 +163,30 @@ def main() -> int:
     else:
         results["ring_pod"] = "skipped"
 
+    # out-of-core soak (round 9): a >= 10x-oversubscribed shuffle through
+    # the tiered spill store, bit-identical to its all-in-HBM control,
+    # with zero synchronous fetches. Runs as a subprocess so its rc-2
+    # gating and JSON line stay self-contained (slow leg: two full
+    # out-of-core passes).
+    if len(jax.devices()) >= 2:
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "oversub_soak.py"),
+             "--host-devices", "0"],
+            capture_output=True, text=True, timeout=1800)
+        sys.stdout.write(proc.stdout)
+        if proc.returncode == 0:
+            results["oversub_soak"] = True
+        elif proc.returncode == 2:      # gated (env refused, not a failure)
+            results["oversub_soak"] = "skipped"
+        else:
+            sys.stderr.write(proc.stderr)
+            results["oversub_soak"] = False
+    else:
+        results["oversub_soak"] = "skipped"
+
     elapsed = time.perf_counter() - t0
     ok = all(bool(vv) for vv in results.values())
     for kk, vv in results.items():

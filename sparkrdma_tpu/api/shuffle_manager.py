@@ -46,6 +46,7 @@ from sparkrdma_tpu.config import ShuffleConf
 from sparkrdma_tpu.exchange.errors import (FetchFailedError,
                                            UnrecoverableShuffleError)
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange, ShufflePlan
+from sparkrdma_tpu.hbm.tiered_store import TieredStore, store_totals
 from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
@@ -375,6 +376,7 @@ class ShuffleReader:
                 from sparkrdma_tpu.hbm.host_staging import spill_count
 
                 serde = codec_totals()
+                st_totals = store_totals()
                 pool = self._m.runtime.pool
                 span = ExchangeSpan(
                     span_id=span_id,
@@ -401,6 +403,10 @@ class ShuffleReader:
                     serde_encode_s=serde["serde_encode_s"],
                     serde_decode_bytes=serde["serde_decode_bytes"],
                     serde_decode_s=serde["serde_decode_s"],
+                    store_spill_bytes=st_totals[0],
+                    store_fetch_bytes=st_totals[1],
+                    store_prefetch_hits=st_totals[2],
+                    store_sync_fetches=st_totals[3],
                     process_index=self._m.runtime.process_index,
                     host_count=self._m.runtime.process_count,
                     # drain restarts the timeline clock, so the next
@@ -555,6 +561,13 @@ class ShuffleManager:
                 compression=self.conf.compression,
                 compression_level=self.conf.compression_level)
         self.store = store
+        # tiered out-of-core store (hbm/tiered_store.py): HBM slot tier +
+        # pinned host leases + CRC'd disk segments. Always constructed —
+        # the host tier is useful even without a disk root (eviction just
+        # refuses when neither spill_tier_dir nor spill_dir is set) — and
+        # handed to the exchange so round buffers are acquired through it
+        # and eviction/prefetch I/O overlaps the exchange rounds.
+        self.tiered = TieredStore(self.conf, pool=self.runtime.pool)
         # unified observability root: either knob turns the registry on
         # (collect_shuffle_read_stats for in-memory stats, metrics_sink
         # for the journal); off, every instrument is a shared no-op
@@ -593,6 +606,12 @@ class ShuffleManager:
                     "pool_outstanding": (
                         lambda: pool.outstanding if pool is not None
                         else 0),
+                    "host_tier_mb": (
+                        lambda: self.tiered.occupancy()["host_bytes"]
+                        // (1 << 20)),
+                    "disk_tier_mb": (
+                        lambda: self.tiered.occupancy()["disk_bytes"]
+                        // (1 << 20)),
                 })
             self.heartbeat.start()
         # per-span event timeline: events accumulate across plan+read and
@@ -629,7 +648,8 @@ class ShuffleManager:
                                          rollup=self.rollup,
                                          identity=(
                                              self.runtime.process_index,
-                                             self.runtime.process_count))
+                                             self.runtime.process_count),
+                                         store=self.tiered)
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
         self._registry = MapOutputRegistry(ids, metrics=self.metrics)
@@ -777,6 +797,41 @@ class ShuffleManager:
                  handle.shuffle_id, plan.total_records)
         return w
 
+    def checkpoint_segments(self, shuffle_id: int, segments,
+                            plan: ShufflePlan, num_parts: int) -> None:
+        """Persist chunked map output as independent CRC'd segment files
+        (see :meth:`MapOutputStore.save_segments`) — the durable twin of
+        the tiered store's chunk keys, enabling :meth:`resume_segments`.
+        """
+        if self.store is None:
+            raise RuntimeError("no MapOutputStore configured "
+                               "(set conf.spill_dir or pass store=)")
+        self.store.save_segments(shuffle_id, segments, plan, num_parts)
+
+    def resume_segments(self, shuffle_id: int) -> list:
+        """Restart path for chunked shuffles: adopt a segment-level
+        checkpoint into the tiered store, replaying ONLY the segments
+        missing from it. Already-resident segments (host or disk tier)
+        are left untouched; adopted ones are registered without reading
+        — the prefetcher pulls them in lazily as the exchange consumes
+        them. Returns the adopted (i.e. previously missing) keys.
+        """
+        if self.store is None:
+            raise RuntimeError("no MapOutputStore configured "
+                               "(set conf.spill_dir or pass store=)")
+        meta = self.store.load_segment_meta(shuffle_id)
+        adopted = []
+        for key, entry in meta["segments"].items():
+            if self.tiered.contains(key):
+                continue
+            self.tiered.adopt(key,
+                              self.store.segment_path(shuffle_id, entry),
+                              entry["shape"], entry["dtype"])
+            adopted.append(key)
+        log.info("shuffle %d segment resume: %d/%d segments replayed",
+                 shuffle_id, len(adopted), len(meta["segments"]))
+        return adopted
+
     def _recover_writer(self, handle: ShuffleHandle) -> ShuffleWriter:
         """Live writer if its map output is intact, else checkpoint."""
         writer = self._writers.get(handle.shuffle_id)
@@ -801,6 +856,7 @@ class ShuffleManager:
         if self.rollup is not None:
             self.rollup.flush()         # close the open window
         self.journal.close()
+        self.tiered.close()
         self._writers.clear()
         self.runtime.stop()
 

@@ -279,6 +279,29 @@ class ShuffleConf:
     compression: str = ""
     compression_level: int = 1        # zlib 1-9 / lzma preset 0-9
 
+    # --- tiered out-of-core store (hbm/tiered_store.py) ---
+    #: disk-segment root for the tiered spill store. Empty (default)
+    #: falls back to ``spill_dir``; when both are empty the store runs
+    #: with its HBM + host tiers only (host-tier evictions that would
+    #: need disk raise instead of silently dropping data).
+    spill_tier_dir: str = ""
+    #: host-tier watermark in bytes: once pinned host-buffer occupancy
+    #: crosses this, the store's background writer evicts least-recently
+    #: -used unpinned segments to the disk tier until back under. The
+    #: eviction runs asynchronously (overlapped with exchange rounds),
+    #: so the watermark is a steady-state target, not a hard cap.
+    spill_tier_host_bytes: int = 1 << 28
+    #: segments the background prefetcher keeps in flight ahead of the
+    #: consumer (disk -> host promotions). A ``get`` of a segment the
+    #: prefetcher already promoted is a hit; a disk-resident ``get``
+    #: with no promotion in flight is a synchronous fetch (the exchange
+    #: blocks on disk — the ``--doctor`` smell). 0 disables prefetch.
+    spill_tier_prefetch: int = 2
+    #: bounded re-reads of a disk segment whose CRC32 trailer mismatches
+    #: before the read raises (transient-media hardening; each overcome
+    #: failure is counted as a ``spill_reread`` recovery).
+    spill_tier_reread_attempts: int = 3
+
     # --- byte-payload serde (api/serde.py, api/pipeline.py) ---
     #: dispatch encode/decode to the multi-threaded C++ codec in
     #: native/staging.cpp when it is available (built on demand, GIL
@@ -344,6 +367,15 @@ class ShuffleConf:
         if self.journal_max_bytes < 0:
             raise ValueError("journal_max_bytes must be >= 0 (0 = no "
                              "rotation)")
+        if self.spill_tier_host_bytes < 0:
+            raise ValueError("spill_tier_host_bytes must be >= 0 (0 = "
+                             "evict every unpinned host segment)")
+        if self.spill_tier_prefetch < 0:
+            raise ValueError("spill_tier_prefetch must be >= 0 (0 "
+                             "disables prefetch)")
+        if self.spill_tier_reread_attempts <= 0:
+            raise ValueError("spill_tier_reread_attempts must be >= 1 "
+                             "(1 = no re-reads)")
         if self.serde_threads < 0:
             raise ValueError("serde_threads must be >= 0 (0 = auto)")
         if self.serde_chunk_records < 0:

@@ -196,11 +196,20 @@ class ShuffleExchange:
                  watchdog: Optional[StallWatchdog] = None,
                  journal=None,
                  rollup=None,
-                 identity: Tuple[int, int] = (0, 1)):
+                 identity: Tuple[int, int] = (0, 1),
+                 store=None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
         self.mesh_size = int(mesh.shape[axis_name])
+        # tiered out-of-core store (hbm/tiered_store.py): when present,
+        # round buffers are acquired/released through it so its
+        # per-acquisition service() poke overlaps host->disk eviction
+        # with the exchange rounds; the HBM tier IS the slot pool, so a
+        # store-only caller inherits its pool.
+        self.store = store
+        if store is not None and pool is None:
+            pool = store.pool
         self.pool = pool
         # disabled registry by default: instrumentation sites stay
         # unconditional (null instruments are no-ops)
@@ -266,6 +275,21 @@ class ShuffleExchange:
         """The transport actually in use (conf choice, or the sticky
         ``xla`` fallback after a transport degradation)."""
         return self._transport_override or self.conf.transport
+
+    def _get_buf(self, shape, sharding):
+        """A device round buffer — through the tiered store when present
+        (its per-acquisition ``service()`` poke lets eviction I/O overlap
+        the round), straight from the pool otherwise. Caller guarantees
+        ``self.pool is not None``."""
+        if self.store is not None:
+            return self.store.acquire_device(shape, jnp.uint32, sharding)
+        return self.pool.get_shaped(shape, jnp.uint32, sharding)
+
+    def _put_buf(self, arr, sharding) -> None:
+        if self.store is not None:
+            self.store.release_device(arr, sharding)
+        else:
+            self.pool.put_shaped(arr, sharding)
 
     def _degrade_transport(self, exc: BaseException) -> None:
         if not self.conf.transport_fallback:
@@ -978,7 +1002,7 @@ class ShuffleExchange:
 
         def get_buf(shape, sharding):
             if self.pool is not None:
-                return self.pool.get_shaped(shape, jnp.uint32, sharding)
+                return self._get_buf(shape, sharding)
             # pool-less fallback: cache the compiled zero-alloc per
             # geometry (a fresh jit per call would recompile once per
             # chunk per exchange — round-2 advisor finding)
@@ -1051,7 +1075,7 @@ class ShuffleExchange:
                 # recv is consumed by the fold already enqueued; returning
                 # it now lets chunk j+1 donate the same pages (the runtime
                 # sequences the rewrite after the fold's read)
-                self.pool.put_shaped(recv, recv_sharding)
+                self._put_buf(recv, recv_sharding)
         tail = cached(("tail", plan.out_capacity, w, sort_key_words,
                        aggregator, float_payload),
                       lambda: self._build_tail(
@@ -1062,7 +1086,7 @@ class ShuffleExchange:
         tl.event("stream:tail")
         if self.pool is not None:
             # the accumulator is free once the (dispatched) tail read it
-            self.pool.put_shaped(acc, out_sharding)
+            self._put_buf(acc, out_sharding)
         self.last_dispatches = dispatches
         self.metrics.counter("exchange.dispatches").inc(dispatches)
         return out, totals, incoming
@@ -1169,10 +1193,9 @@ class ShuffleExchange:
                 sharding = NamedSharding(self.mesh, P(None, self.axis_name))
                 prev = self._out_prev.pop(okey, None)
                 if prev is not None:
-                    self.pool.put_shaped(prev[0], prev[1])
-                buf = self.pool.get_shaped(
-                    (w, self.mesh_size * plan.out_capacity), jnp.uint32,
-                    sharding)
+                    self._put_buf(prev[0], prev[1])
+                buf = self._get_buf(
+                    (w, self.mesh_size * plan.out_capacity), sharding)
                 out, totals, incoming = fn(records, buf)
                 self._out_prev[okey] = (out, sharding)
                 return out, totals, incoming
@@ -1193,7 +1216,7 @@ class ShuffleExchange:
             return
         for okey in [k for k in self._out_prev if k[0] == shuffle_id]:
             arr, sharding = self._out_prev.pop(okey)
-            self.pool.put_shaped(arr, sharding)
+            self._put_buf(arr, sharding)
 
     def shuffle(
         self,
@@ -1237,9 +1260,11 @@ class ShuffleExchange:
                 per_source_records=plan.counts.sum(axis=1),
             ))
         if journal_on:
+            from sparkrdma_tpu.hbm.tiered_store import store_totals
             from sparkrdma_tpu.obs.journal import (ExchangeSpan,
                                                    next_span_id)
             span_id = next_span_id()
+            st_spill, st_fetch, st_hits, st_sync = store_totals()
             span = ExchangeSpan(
                 span_id=span_id,
                 shuffle_id=shuffle_id,
@@ -1257,6 +1282,10 @@ class ShuffleExchange:
                 process_index=self.identity[0],
                 host_count=self.identity[1],
                 events=self.timeline.drain(),
+                store_spill_bytes=st_spill,
+                store_fetch_bytes=st_fetch,
+                store_prefetch_hits=st_hits,
+                store_sync_fetches=st_sync,
             )
             weight = self.sampler.keep_weight(span_id, t.elapsed)
             if self.rollup is not None:

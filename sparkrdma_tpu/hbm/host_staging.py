@@ -124,6 +124,30 @@ def crc_frame(arr: np.ndarray) -> np.ndarray:
     return np.concatenate([flat, trailer])
 
 
+def crc_frame_into(arr: np.ndarray, pool: "HostBufferPool"):
+    """Stage ``payload + CRC32 trailer`` into a pooled host-buffer lease.
+
+    Bit-identical frame bytes to :func:`crc_frame`, but the staging
+    buffer comes from a :class:`HostBufferPool` instead of a fresh
+    ``np.concatenate`` allocation — steady-state spilling through a pool
+    is allocation-free (the rss-creep fix). Returns ``(frame, lease)``:
+    ``frame`` is the exact-size uint8 view to hand to a writer, and the
+    caller must ``lease.release()`` once the write has landed
+    (:class:`SpillWriter` does this at drain).
+    """
+    import zlib
+
+    flat = _as_u8(arr)
+    n = flat.nbytes + _CRC_TRAILER.size
+    lease = pool.get(n)
+    frame = lease.view(np.uint8, (n,))
+    frame[:flat.nbytes] = flat
+    frame[flat.nbytes:] = np.frombuffer(
+        _CRC_TRAILER.pack(_CRC_MAGIC, zlib.crc32(flat) & 0xFFFFFFFF),
+        np.uint8)
+    return frame, lease
+
+
 def verify_crc(payload: np.ndarray, trailer: bytes, path: str) -> None:
     """Check an 8-byte trailer against the payload; OSError on mismatch."""
     import zlib
@@ -493,16 +517,23 @@ class SpillWriter:
     """
 
     def __init__(self, depth: int = 8, use_native: bool = True,
-                 codec: str = "", level: int = 1, checksum: bool = True):
+                 codec: str = "", level: int = 1, checksum: bool = True,
+                 pool: Optional["HostBufferPool"] = None):
         # codec != "": every submitted array is compressed (header +
         # blob, see compress_array). Compression runs synchronously in
         # submit() — zlib releases the GIL but the caller still waits;
         # it is an opt-in trade of submit latency for disk bytes.
+        #
+        # pool: stage CRC frames in HostBufferPool leases instead of
+        # fresh np.concatenate allocations (released at drain/close) —
+        # steady-state spilling stops allocating.
         if codec and codec not in _CODEC_IDS:
             raise ValueError(f"unknown compression codec {codec!r}")
         self._codec = codec
         self._level = level
         self._checksum = checksum
+        self._pool = pool
+        self._leases: List[HostBuffer] = []   # released at drain/close
         self._lib = load_native() if use_native else None
         self._pending: List[np.ndarray] = []  # keep-alive until drain
         if self._lib is not None:
@@ -537,7 +568,11 @@ class SpillWriter:
             arr = np.frombuffer(
                 compress_array(arr, self._codec, self._level), np.uint8)
         if self._checksum:
-            arr = crc_frame(arr)
+            if self._pool is not None:
+                arr, lease = crc_frame_into(arr, self._pool)
+                self._leases.append(lease)
+            else:
+                arr = crc_frame(arr)
             if corrupt:
                 # storage-corruption injection: the trailer holds the
                 # TRUE payload's CRC, the payload is mangled — exactly
@@ -566,7 +601,13 @@ class SpillWriter:
             errors = self._fb_errors
             self._fb_errors = 0
         self._pending.clear()
+        self._release_leases()
         return errors
+
+    def _release_leases(self) -> None:
+        for lease in self._leases:
+            lease.release()
+        self._leases.clear()
 
     def close(self) -> None:
         if self._handle is not None:
@@ -578,32 +619,46 @@ class SpillWriter:
             self._fb.join(timeout=10)
             self._fb = None
         self._pending.clear()
+        self._release_leases()
 
 
 def write_array(path: str, arr: np.ndarray, use_native: bool = True,
                 codec: str = "", level: int = 1,
-                checksum: bool = True) -> None:
+                checksum: bool = True,
+                pool: Optional[HostBufferPool] = None) -> None:
     """Synchronous single-array spill (optionally compressed), ending in
-    a CRC32 trailer (``checksum=False`` reproduces the legacy layout)."""
+    a CRC32 trailer (``checksum=False`` reproduces the legacy layout).
+    ``pool`` stages the CRC frame in a pooled lease (released before
+    return) so repeated spills stop allocating."""
     _count_spill(arr.nbytes)
     corrupt = _fire_spill_write(path)
     if codec:
         arr = np.frombuffer(compress_array(arr, codec, level), np.uint8)
+    lease = None
     if checksum:
-        arr = crc_frame(arr)
+        if pool is not None:
+            arr, lease = crc_frame_into(arr, pool)
+        else:
+            arr = crc_frame(arr)
         if corrupt:
             arr[0] ^= 0x01   # see SpillWriter.submit
-    arr = np.ascontiguousarray(arr)
-    lib = load_native() if use_native else None
-    if lib is not None:
-        rc = lib.sr_write_file(path.encode(), arr.ctypes.data, arr.nbytes)
-        if rc != arr.nbytes:
-            raise OSError(f"native write to {path} failed: rc={rc}")
-    else:
-        arr.tofile(path)
+    try:
+        arr = np.ascontiguousarray(arr)
+        lib = load_native() if use_native else None
+        if lib is not None:
+            rc = lib.sr_write_file(path.encode(), arr.ctypes.data,
+                                   arr.nbytes)
+            if rc != arr.nbytes:
+                raise OSError(f"native write to {path} failed: rc={rc}")
+        else:
+            arr.tofile(path)
+    finally:
+        if lease is not None:
+            lease.release()
 
 
-def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
+def read_array(path: str, dtype, shape, use_native: bool = True,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
     """Read back a spilled array of known dtype/shape.
 
     Compressed files self-describe (header leads with the codec magic
@@ -614,6 +669,10 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
     silently hand back compressed bytes as records), and a raw file
     that merely STARTS with the magic falls through to the raw path
     via the header's raw-size field disagreeing.
+
+    ``out``: a C-contiguous destination of exactly ``shape``/``dtype``
+    (e.g. a :class:`HostBufferPool` lease view) — the payload lands
+    there and ``out`` is returned, so fetch loops stop allocating.
     """
     from sparkrdma_tpu import faults as _faults
 
@@ -646,14 +705,18 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
                 if len(raw) != expected:
                     raise OSError(f"spill file {path} holds {len(raw)} "
                                   f"raw bytes, expected {expected}")
-                return np.frombuffer(raw, dtype=dtype).reshape(shape) \
-                    .copy()
+                decoded = np.frombuffer(raw, dtype=dtype).reshape(shape)
+                if out is not None:
+                    out[...] = decoded
+                    return out
+                return decoded.copy()
     has_trailer = actual == expected + tsz
     if actual != expected and not has_trailer:
         raise OSError(f"spill file {path} is {actual} bytes, expected "
                       f"{expected} raw (and no valid compression "
                       "header) — truncated or corrupt")
-    out = np.empty(shape, dtype=dtype)
+    if out is None:
+        out = np.empty(shape, dtype=dtype)
     lib = load_native() if use_native else None
     if lib is not None:
         # reads the first out.nbytes bytes — the trailer, when present,
@@ -662,10 +725,10 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
         if rc != out.nbytes:
             raise OSError(f"native read of {path} short: rc={rc}")
     else:
-        data = np.fromfile(path, dtype=dtype, count=int(np.prod(shape)))
-        if data.size != int(np.prod(shape)):
+        with open(path, "rb") as f:
+            n = f.readinto(memoryview(_as_u8(out))[:expected])
+        if n != expected:
             raise OSError(f"spill file {path} has wrong size")
-        out = data.reshape(shape)
     if has_trailer:
         with open(path, "rb") as f:
             f.seek(expected)
@@ -679,4 +742,4 @@ def read_array(path: str, dtype, shape, use_native: bool = True) -> np.ndarray:
 __all__ = ["HostBufferPool", "HostBuffer", "SpillWriter", "write_array",
            "read_array", "load_native", "codec_available",
            "compress_array", "decompress_blob", "spill_count",
-           "crc_frame", "verify_crc"]
+           "crc_frame", "crc_frame_into", "verify_crc"]

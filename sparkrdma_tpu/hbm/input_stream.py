@@ -96,6 +96,35 @@ class FileChunkSource:
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 
+class StoreChunkSource:
+    """Chunks served out of a :class:`~sparkrdma_tpu.hbm.tiered_store
+    .TieredStore` by key, prefetching ahead of the consumer.
+
+    This is how a full shuffle runs without all map output resident:
+    chunks are published into the store (which evicts cold ones to disk
+    under its watermark) and fetched back just-in-time — ``chunk(j)``
+    queues promotions for the next ``lookahead`` keys before returning
+    chunk ``j``, so the disk read of chunk ``j+2`` overlaps the exchange
+    of chunk ``j`` (the round k/k+1/k+2 overlap of the tiered store,
+    applied to the input side). A miss shows up as a ``store.sync_fetches``
+    tick and a ``--doctor`` flag, not a silent stall.
+    """
+
+    def __init__(self, store, keys: Sequence[str], lookahead: int = 2):
+        self._store = store
+        self._keys = list(keys)
+        self._lookahead = max(0, lookahead)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def chunk(self, j: int) -> np.ndarray:
+        if self._lookahead > 0:
+            self._store.prefetch(
+                self._keys[j + 1:j + 1 + self._lookahead])
+        return self._store.get(self._keys[j])
+
+
 class InputStreamer:
     """Double-buffered host→HBM chunk feed.
 
@@ -131,4 +160,5 @@ class InputStreamer:
             yield pending.pop(0)
 
 
-__all__ = ["InputStreamer", "ArrayChunkSource", "FileChunkSource"]
+__all__ = ["InputStreamer", "ArrayChunkSource", "FileChunkSource",
+           "StoreChunkSource"]

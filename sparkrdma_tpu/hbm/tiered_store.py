@@ -1,0 +1,555 @@
+"""Tiered out-of-core segment store: HBM slots -> pinned host -> disk.
+
+SparkRDMA keeps Spark's disk-backed shuffle files as the durability tier
+under its RDMA fast path (PAPER.md: the NIC accelerates the fetch, the
+files still live on disk). The TPU analog is this three-tier store:
+
+- **HBM tier** — the existing :class:`~sparkrdma_tpu.hbm.slot_pool
+  .SlotPool`. Device buffers for the rounds currently in flight; the
+  store delegates ``acquire_device``/``release_device`` straight to the
+  pool so the exchange's donated-buffer discipline is unchanged.
+- **host tier** — segments staged in :class:`~sparkrdma_tpu.hbm
+  .host_staging.HostBufferPool` leases (aligned, size-classed, reused),
+  bounded by the ``ShuffleConf.spill_tier_host_bytes`` watermark.
+- **disk tier** — CRC32-trailed segment files (the ``crc_frame`` layout
+  shared with spills and checkpoints) under ``spill_tier_dir``.
+
+All host<->disk traffic runs on two daemon threads — a **writer** that
+evicts least-recently-used unpinned segments once host occupancy crosses
+the watermark, and a **prefetcher** that promotes disk segments back
+into host leases ahead of the consumer — so spill of round k's consumed
+segments and fetch of round k+2's segments overlap round k+1's exchange
+(the same latency-hiding discipline as the serde pipeline's
+double-buffered hand-off and the ring transport's parity banks). A
+``get`` that finds its segment on disk with no promotion in flight is a
+**synchronous fetch**: the caller blocks on disk, the counter
+``store.sync_fetches`` ticks, and ``shuffle_report --doctor`` calls it
+out (raise ``spill_tier_prefetch`` / ``spill_tier_host_bytes``).
+
+Disk reads verify the CRC trailer with bounded re-reads
+(``spill_tier_reread_attempts``); an overcome mismatch is a
+``spill_reread`` recovery, a persistent one raises ``OSError``.
+
+Counters live in the process-wide registry (like ``staging.spills``) so
+:func:`store_totals` can fold cumulative values into journal spans from
+any manager; per-tier occupancy rides the ``store.host_bytes`` /
+``store.disk_bytes`` gauges and the heartbeat lines.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.hbm.host_staging import (HostBuffer, HostBufferPool,
+                                            read_array, write_array)
+
+
+def _reg():
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    return global_registry()
+
+
+def store_totals() -> Tuple[int, int, int, int]:
+    """Process-cumulative ``(spill_bytes, fetch_bytes, prefetch_hits,
+    sync_fetches)`` — the journal-span folding source (spill_count
+    pattern: spans carry the cumulative value, readers diff)."""
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    reg = global_registry()
+    return (int(reg.counter("store.spill_bytes").value),
+            int(reg.counter("store.fetch_bytes").value),
+            int(reg.counter("store.prefetch_hits").value),
+            int(reg.counter("store.sync_fetches").value))
+
+
+class _Segment:
+    """Book-keeping for one stored segment (guarded by the store lock)."""
+
+    __slots__ = ("key", "shape", "dtype", "nbytes", "tier", "pinned",
+                 "tick", "lease", "path", "promoted", "wanted", "event",
+                 "error")
+
+    def __init__(self, key: str, shape, dtype, nbytes: int):
+        self.key = key
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = nbytes
+        self.tier = "host"            # "host" | "disk"
+        self.pinned = False
+        self.tick = 0
+        self.lease: Optional[HostBuffer] = None
+        self.path: Optional[str] = None
+        #: a promotion is (or was) in flight for this segment
+        self.promoted = False
+        #: a consumer prefetched this host-resident segment: it is about
+        #: to be read, so eviction must not demote it (prefetch/evict race)
+        self.wanted = False
+        self.event: Optional[threading.Event] = None
+        self.error: Optional[OSError] = None
+
+
+class TieredStore:
+    """Watermark-evicting, prefetching HBM/host/disk segment store."""
+
+    def __init__(self, conf: Optional[ShuffleConf] = None, pool=None,
+                 root: str = "", host_pool: Optional[HostBufferPool] = None):
+        conf = conf or ShuffleConf()
+        self.conf = conf
+        self.pool = pool                     # HBM tier (SlotPool), optional
+        self.root = root or conf.spill_tier_dir or conf.spill_dir
+        self._use_native = conf.use_native_staging
+        self._watermark = conf.spill_tier_host_bytes
+        self._prefetch_depth = conf.spill_tier_prefetch
+        self._reread_attempts = conf.spill_tier_reread_attempts
+        self.host_pool = host_pool or HostBufferPool(
+            use_native=conf.use_native_staging)
+        self._own_host_pool = host_pool is None
+        self._segments: Dict[str, _Segment] = {}
+        self._lock = threading.Lock()
+        self._tick = 0                       # guarded-by: _lock
+        self._host_bytes = 0                 # guarded-by: _lock
+        self._disk_bytes = 0                 # guarded-by: _lock
+        self._closed = False
+        # background writer: pokes -> evict down to the watermark
+        self._wq: "queue.Queue" = queue.Queue()
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        daemon=True,
+                                        name="tiered-store-writer")
+        self._writer.start()
+        # background prefetcher: keys -> disk->host promotions
+        self._pq: "queue.Queue" = queue.Queue()
+        self._prefetcher = threading.Thread(target=self._prefetch_loop,
+                                            daemon=True,
+                                            name="tiered-store-prefetch")
+        self._prefetcher.start()
+
+    # ------------------------------------------------------------------
+    # HBM tier: thin delegates so the exchange acquires round buffers
+    # "through the store" without changing the donated-slot discipline
+    # ------------------------------------------------------------------
+    def acquire_device(self, shape, dtype, sharding=None):
+        """A device round buffer from the HBM tier (SlotPool delegate).
+
+        Each acquisition also pokes the background writer — the natural
+        per-round hook that lets eviction overlap the exchange."""
+        self.service()
+        return self.pool.get_shaped(shape, dtype, sharding)
+
+    def release_device(self, arr, sharding=None) -> None:
+        self.pool.put_shaped(arr, sharding)
+
+    def service(self) -> None:
+        """Non-blocking poke: wake the writer if host occupancy is over
+        the watermark. Called per exchange chunk / per acquisition so
+        eviction I/O overlaps device rounds instead of serializing."""
+        with self._lock:
+            over = self._host_bytes > self._watermark and not self._closed
+        if over:
+            self._wq.put("evict")
+
+    # ------------------------------------------------------------------
+    # host tier
+    # ------------------------------------------------------------------
+    def put(self, key: str, arr: np.ndarray, pin: bool = False) -> None:
+        """Stage ``arr`` (copied into a pooled host lease) under ``key``.
+
+        Watermark enforcement is asynchronous: the put always lands in
+        the host tier (so the producer never blocks on disk), then the
+        background writer evicts LRU segments until back under."""
+        arr = np.ascontiguousarray(arr)
+        seg = _Segment(key, arr.shape, arr.dtype, arr.nbytes)
+        lease = self.host_pool.get(arr.nbytes)
+        lease.view(arr.dtype, arr.shape)[...] = arr
+        seg.lease = lease
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("TieredStore is closed")
+            old = self._segments.pop(key, None)
+            self._tick += 1
+            seg.tick = self._tick
+            seg.pinned = pin
+            self._segments[key] = seg
+            self._host_bytes += seg.nbytes
+            over = self._host_bytes > self._watermark
+        if old is not None:
+            self._discard(old)
+        reg = _reg()
+        reg.counter("store.puts").inc()
+        reg.counter("store.put_bytes").inc(arr.nbytes)
+        self._set_gauges()
+        if over:
+            self._wq.put("evict")
+
+    def get(self, key: str) -> np.ndarray:
+        """The segment's records (a copy — safe across later evictions).
+
+        Host-resident segments return immediately. A disk segment with a
+        promotion in flight waits for it (counted as a prefetch hit: the
+        I/O overlapped someone else's compute). A disk segment with no
+        promotion is read synchronously — the stall ``--doctor`` flags.
+        """
+        from sparkrdma_tpu.obs.timeline import record_active
+
+        with self._lock:
+            seg = self._segments.get(key)
+            if seg is None:
+                raise KeyError(f"no segment {key!r} in store")
+            self._tick += 1
+            seg.tick = self._tick
+            seg.wanted = False
+            tier = seg.tier
+            ev = seg.event
+            if tier == "host":
+                if seg.promoted:
+                    seg.promoted = False
+                    _reg().counter("store.prefetch_hits").inc()
+                return np.array(seg.lease.view(seg.dtype, seg.shape))
+        if ev is not None:
+            # promotion in flight: ride it (the disk read overlapped)
+            ev.wait()
+            with self._lock:
+                seg = self._segments.get(key)
+                if seg is None:
+                    raise KeyError(f"segment {key!r} deleted mid-promote")
+                if seg.error is not None:
+                    raise seg.error
+                if seg.tier == "host":
+                    seg.promoted = False
+                    _reg().counter("store.prefetch_hits").inc()
+                    return np.array(seg.lease.view(seg.dtype, seg.shape))
+        # synchronous fetch: the consumer is blocked on disk right now
+        _reg().counter("store.sync_fetches").inc()
+        record_active("spill:fetch", key=key, sync=True)
+        data = self._read_segment(seg)
+        self._promote_locked_install(key, data)
+        return data
+
+    def prefetch(self, keys: Iterable[str]) -> None:
+        """Queue disk->host promotions for ``keys`` (bounded by
+        ``spill_tier_prefetch``; extra keys are quietly dropped — they
+        will fetch synchronously, which the counters then show)."""
+        if self._prefetch_depth <= 0:
+            return
+        budget = self._prefetch_depth - self._pq.qsize()
+        for key in keys:
+            if budget <= 0:
+                return
+            with self._lock:
+                seg = self._segments.get(key)
+                if seg is None:
+                    continue
+                if seg.tier == "host":
+                    # already resident (possibly mid-eviction): mark it
+                    # wanted so the writer won't demote it out from under
+                    # the imminent get — the prefetch/evict race that
+                    # would otherwise become a synchronous fetch
+                    seg.wanted = True
+                    continue
+                if seg.event is not None:
+                    continue
+                seg.event = threading.Event()
+            self._pq.put(key)
+            budget -= 1
+
+    def pin(self, key: str) -> None:
+        with self._lock:
+            self._segments[key].pinned = True
+
+    def unpin(self, key: str) -> None:
+        with self._lock:
+            self._segments[key].pinned = False
+
+    # ------------------------------------------------------------------
+    # disk tier
+    # ------------------------------------------------------------------
+    def adopt(self, key: str, path: str, shape, dtype) -> None:
+        """Register an EXISTING on-disk file (e.g. a checkpoint segment)
+        as a disk-tier segment — no data is read until someone gets or
+        prefetches it. The restart path: resume replays only segments
+        missing from the store, and even those lazily."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        seg = _Segment(key, shape, dtype, nbytes)
+        seg.tier = "disk"
+        seg.path = path
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("TieredStore is closed")
+            old = self._segments.pop(key, None)
+            self._tick += 1
+            seg.tick = self._tick
+            self._segments[key] = seg
+            self._disk_bytes += nbytes
+        if old is not None:
+            self._discard(old)
+        self._set_gauges()
+
+    def _segment_path(self, key: str) -> str:
+        if not self.root:
+            raise OSError(
+                f"cannot evict segment {key!r}: no disk tier configured "
+                "(set ShuffleConf.spill_tier_dir or spill_dir)")
+        os.makedirs(self.root, exist_ok=True)
+        safe = key.replace(os.sep, "_").replace("/", "_")
+        return os.path.join(self.root, f"{safe}.seg")
+
+    def _read_segment(self, seg: _Segment) -> np.ndarray:
+        """CRC-verified disk read with bounded re-reads on mismatch."""
+        from sparkrdma_tpu import faults as _faults
+
+        last: Optional[OSError] = None
+        for attempt in range(self._reread_attempts):
+            try:
+                data = read_array(seg.path, seg.dtype, seg.shape,
+                                  use_native=self._use_native)
+                if attempt > 0:
+                    _faults.note_recovery("spill_reread")
+                reg = _reg()
+                reg.counter("store.fetches").inc()
+                reg.counter("store.fetch_bytes").inc(seg.nbytes)
+                return data
+            except OSError as e:
+                last = e
+                if attempt < self._reread_attempts - 1:
+                    _reg().counter("store.crc_rereads").inc()
+        raise OSError(
+            f"segment {seg.key!r} unreadable after "
+            f"{self._reread_attempts} attempts: {last}") from last
+
+    def _promote_locked_install(self, key: str, data: np.ndarray) -> None:
+        """Install freshly-read bytes as the segment's host residence."""
+        lease = self.host_pool.get(data.nbytes)
+        lease.view(data.dtype, data.shape)[...] = data
+        stale: Optional[HostBuffer] = None
+        with self._lock:
+            seg = self._segments.get(key)
+            if seg is None or seg.tier == "host":
+                stale = lease         # raced with delete / another read
+            else:
+                seg.tier = "host"
+                seg.lease = lease
+                # freshly promoted = about to be consumed: make it MRU so
+                # the writer doesn't evict it straight back (thrash)
+                self._tick += 1
+                seg.tick = self._tick
+                self._host_bytes += seg.nbytes
+                self._disk_bytes -= seg.nbytes
+                over = self._host_bytes > self._watermark
+        if stale is not None:
+            stale.release()
+            return
+        self._set_gauges()
+        if over:
+            self._wq.put("evict")
+
+    # ------------------------------------------------------------------
+    # background threads
+    # ------------------------------------------------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._wq.get()
+            if item is None:
+                self._wq.task_done()
+                return
+            try:
+                self._evict_until_under()
+            finally:
+                self._wq.task_done()
+
+    def _evict_until_under(self) -> None:
+        from sparkrdma_tpu.obs.timeline import record_active
+
+        while True:
+            with self._lock:
+                if self._closed or self._host_bytes <= self._watermark:
+                    return
+                victims = [s for s in self._segments.values()
+                           if s.tier == "host" and not s.pinned
+                           and not s.wanted]
+                if not victims:
+                    return
+                seg = min(victims, key=lambda s: s.tick)
+                # mark in-flight so a concurrent get keeps working
+                # against the still-valid lease view
+                seg.pinned = True
+            try:
+                path = self._segment_path(seg.key)
+                write_array(path, seg.lease.view(seg.dtype, seg.shape),
+                            use_native=self._use_native,
+                            pool=self.host_pool)
+            except OSError:
+                # disk refused (no tier configured / full): leave the
+                # segment host-resident; data is never dropped
+                with self._lock:
+                    seg.pinned = False
+                return
+            orphan = None
+            with self._lock:
+                still = self._segments.get(seg.key) is seg
+                if still and seg.wanted:
+                    # a prefetch claimed it mid-write: stay host-resident
+                    # (the written file is an orphan — remove it)
+                    seg.pinned = False
+                    lease = None
+                    orphan = path
+                elif still:
+                    seg.pinned = False
+                    seg.tier = "disk"
+                    seg.path = path
+                    lease, seg.lease = seg.lease, None
+                    self._host_bytes -= seg.nbytes
+                    self._disk_bytes += seg.nbytes
+                else:
+                    lease = None
+            if orphan is not None:
+                try:
+                    os.remove(orphan)
+                except OSError:
+                    pass
+                continue
+            if lease is not None:
+                lease.release()
+            reg = _reg()
+            reg.counter("store.spill_writes").inc()
+            reg.counter("store.spill_bytes").inc(seg.nbytes)
+            record_active("spill:write", key=seg.key, bytes=seg.nbytes)
+            self._set_gauges()
+
+    def _prefetch_loop(self) -> None:
+        from sparkrdma_tpu.obs.timeline import record_active
+
+        while True:
+            key = self._pq.get()
+            if key is None:
+                self._pq.task_done()
+                return
+            try:
+                with self._lock:
+                    seg = self._segments.get(key)
+                    ev = seg.event if seg is not None else None
+                if seg is None or ev is None:
+                    continue
+                if seg.tier == "disk":
+                    try:
+                        data = self._read_segment(seg)
+                    except OSError as e:
+                        with self._lock:
+                            seg.error = e
+                            seg.event = None
+                        ev.set()
+                        continue
+                    self._promote_locked_install(key, data)
+                    with self._lock:
+                        if self._segments.get(key) is seg:
+                            seg.promoted = True
+                    record_active("spill:promote", key=key, bytes=seg.nbytes)
+                with self._lock:
+                    seg.event = None
+                ev.set()
+            finally:
+                self._pq.task_done()
+
+    # ------------------------------------------------------------------
+    # inventory
+    # ------------------------------------------------------------------
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._segments
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._segments)
+
+    def tier_of(self, key: str) -> str:
+        with self._lock:
+            return self._segments[key].tier
+
+    def occupancy(self) -> Dict[str, int]:
+        """Per-tier occupancy snapshot (heartbeat / rollup source)."""
+        with self._lock:
+            host_n = sum(1 for s in self._segments.values()
+                         if s.tier == "host")
+            return {
+                "host_bytes": self._host_bytes,
+                "disk_bytes": self._disk_bytes,
+                "host_segments": host_n,
+                "disk_segments": len(self._segments) - host_n,
+                "hbm_outstanding": (self.pool.outstanding
+                                    if self.pool is not None else 0),
+            }
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            seg = self._segments.pop(key, None)
+            if seg is None:
+                return
+            if seg.tier == "host":
+                self._host_bytes -= seg.nbytes
+            else:
+                self._disk_bytes -= seg.nbytes
+        self._discard(seg)
+        self._set_gauges()
+
+    def _discard(self, seg: _Segment) -> None:
+        if seg.lease is not None:
+            seg.lease.release()
+            seg.lease = None
+        if seg.path is not None and seg.path.endswith(".seg"):
+            # store-owned files only; adopted checkpoint files stay
+            try:
+                os.remove(seg.path)
+            except OSError:
+                pass
+
+    def _set_gauges(self) -> None:
+        from sparkrdma_tpu.obs.metrics import global_registry
+
+        reg = global_registry()
+        with self._lock:
+            reg.gauge("store.host_bytes").set(self._host_bytes)
+            reg.gauge("store.disk_bytes").set(self._disk_bytes)
+
+    def drain(self) -> None:
+        """Block until every queued eviction poke and prefetch has been
+        fully processed (the poke itself evicts down to the watermark,
+        so after drain host occupancy is under it — or only pinned /
+        unevictable segments remain)."""
+        self._wq.put("evict")
+        self._wq.join()
+        self._pq.join()
+
+    def close(self, delete_disk: bool = False) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            segs = list(self._segments.values())
+            self._segments.clear()
+            self._host_bytes = 0
+            self._disk_bytes = 0
+        self._wq.put(None)
+        self._pq.put(None)
+        self._writer.join(timeout=10)
+        self._prefetcher.join(timeout=10)
+        for seg in segs:
+            if seg.lease is not None:
+                seg.lease.release()
+                seg.lease = None
+            if delete_disk and seg.path is not None \
+                    and seg.path.endswith(".seg"):
+                try:
+                    os.remove(seg.path)
+                except OSError:
+                    pass
+        if self._own_host_pool:
+            self.host_pool.close()
+
+
+__all__ = ["TieredStore", "store_totals"]

@@ -89,7 +89,10 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: process-cumulative host codec totals (api/serde.py), spill_count-style.
 #: v5: + ``backoff_ms`` (per-attempt retry backoff delays, ms) and
 #: ``degraded`` (sticky fallback names active at emit — faults.py ladder).
-SCHEMA_VERSION = 5
+#: v6: + ``store_spill_bytes``/``store_fetch_bytes``/``store_prefetch_hits``
+#: /``store_sync_fetches`` — process-cumulative tiered-store totals
+#: (hbm/tiered_store.py), spill_count-style.
+SCHEMA_VERSION = 6
 
 
 @dataclasses.dataclass
@@ -134,6 +137,13 @@ class ExchangeSpan:
     # sticky degradations active when the span was emitted (e.g.
     # "serde_native", "transport") — see sparkrdma_tpu/faults.py
     degraded: List[str] = dataclasses.field(default_factory=list)
+    # --- tiered out-of-core store totals (schema v6) — PROCESS-CUMULATIVE
+    # like ``spill_count``: consumers diff consecutive spans. A read that
+    # raised ``store_sync_fetches`` blocked on disk (prefetch miss) ---
+    store_spill_bytes: int = 0
+    store_fetch_bytes: int = 0
+    store_prefetch_hits: int = 0
+    store_sync_fetches: int = 0
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

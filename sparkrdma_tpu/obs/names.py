@@ -55,6 +55,15 @@ COUNTERS = frozenset({
     "exchange.exchanges",
     "exchange.rounds",
     "exchange.records",
+    "store.puts",
+    "store.put_bytes",
+    "store.spill_writes",
+    "store.spill_bytes",
+    "store.fetches",
+    "store.fetch_bytes",
+    "store.prefetch_hits",
+    "store.sync_fetches",
+    "store.crc_rereads",
 })
 
 #: Point-in-time gauges (``registry.gauge(name)``).
@@ -62,6 +71,8 @@ GAUGES = frozenset({
     "pool.outstanding",
     "meta.registered_shuffles",
     "reads.in_flight",
+    "store.host_bytes",
+    "store.disk_bytes",
 })
 
 #: Distributions (``registry.histogram(name)``).

@@ -56,6 +56,8 @@ ROLLUP_FIELDS = frozenset({
     "retries", "spills", "streaming_reads", "fused_reads",
     "serde_encode_bytes", "serde_encode_mbps",
     "serde_decode_bytes", "serde_decode_mbps",
+    "store_spill_bytes", "store_fetch_bytes",
+    "store_prefetch_hits", "store_sync_fetches",
     "lat_bounds_ms", "lat_buckets", "lat_sum_ms", "lat_max_ms",
     "p50_ms", "p95_ms", "p99_ms",
 })
@@ -64,7 +66,7 @@ ROLLUP_FIELDS = frozenset({
 HEARTBEAT_FIELDS = frozenset({
     "kind", "schema", "ts", "seq", "process_index", "host_count", "host",
     "pid", "uptime_s", "in_flight", "pool_outstanding", "spans_emitted",
-    "rotations", "rss_mb",
+    "rotations", "rss_mb", "host_tier_mb", "disk_tier_mb",
 })
 
 
@@ -83,6 +85,8 @@ class _Cell:
                  "dispatches", "retries", "spills", "streaming_reads",
                  "fused_reads", "serde_encode_bytes", "serde_encode_s",
                  "serde_decode_bytes", "serde_decode_s",
+                 "store_spill_bytes", "store_fetch_bytes",
+                 "store_prefetch_hits", "store_sync_fetches",
                  "lat_buckets", "lat_sum_ms", "lat_max_ms")
 
     def __init__(self):
@@ -100,6 +104,10 @@ class _Cell:
         self.serde_encode_s = 0.0
         self.serde_decode_bytes = 0
         self.serde_decode_s = 0.0
+        self.store_spill_bytes = 0
+        self.store_fetch_bytes = 0
+        self.store_prefetch_hits = 0
+        self.store_sync_fetches = 0
         self.lat_buckets = [0] * (len(LATENCY_BOUNDS_MS) + 1)
         self.lat_sum_ms = 0.0
         self.lat_max_ms = 0.0
@@ -134,6 +142,9 @@ class RollupAggregator:
         # serde codec totals are process-cumulative too (schema v4);
         # windows carry the delta, same trick as spills
         self._last_serde = (0, 0.0, 0, 0.0)          # guarded-by: _lock
+        # tiered-store totals (schema v6): cumulative spill/fetch bytes,
+        # prefetch hits, sync fetches — same delta folding
+        self._last_store = (0, 0, 0, 0)              # guarded-by: _lock
         #: rollup lines emitted over this aggregator's lifetime
         self.emitted = 0                             # guarded-by: _lock
 
@@ -171,6 +182,15 @@ class RollupAggregator:
                 cell.serde_decode_bytes += cur[2] - last[2]
                 cell.serde_decode_s += cur[3] - last[3]
                 self._last_serde = cur
+            store = (span.store_spill_bytes, span.store_fetch_bytes,
+                     span.store_prefetch_hits, span.store_sync_fetches)
+            if store > self._last_store:
+                last = self._last_store
+                cell.store_spill_bytes += store[0] - last[0]
+                cell.store_fetch_bytes += store[1] - last[1]
+                cell.store_prefetch_hits += store[2] - last[2]
+                cell.store_sync_fetches += store[3] - last[3]
+                self._last_store = store
             if span.dispatches > 1:
                 cell.streaming_reads += 1
             else:
@@ -224,6 +244,10 @@ class RollupAggregator:
                 "serde_decode_mbps": round(
                     c.serde_decode_bytes / c.serde_decode_s / 1e6, 3)
                 if c.serde_decode_s > 0 else 0.0,
+                "store_spill_bytes": c.store_spill_bytes,
+                "store_fetch_bytes": c.store_fetch_bytes,
+                "store_prefetch_hits": c.store_prefetch_hits,
+                "store_sync_fetches": c.store_sync_fetches,
                 "lat_bounds_ms": list(LATENCY_BOUNDS_MS),
                 "lat_buckets": list(c.lat_buckets),
                 "lat_sum_ms": round(c.lat_sum_ms, 3),
@@ -337,6 +361,8 @@ class HeartbeatEmitter:
                 "spans_emitted": getattr(self._journal, "emitted", 0),
                 "rotations": getattr(self._journal, "rotations", 0),
                 "rss_mb": rss_mb(),
+                "host_tier_mb": self._probe("host_tier_mb"),
+                "disk_tier_mb": self._probe("disk_tier_mb"),
             }
             if set(d) != HEARTBEAT_FIELDS:
                 # must survive python -O; caught + counted just below

@@ -359,6 +359,8 @@ class TestSchemaV2V3:
             "serde_encode_bytes", "serde_encode_s",   # v4: host codec
             "serde_decode_bytes", "serde_decode_s",
             "backoff_ms", "degraded",          # v5: recovery hardening
+            "store_spill_bytes", "store_fetch_bytes",   # v6: tiered store
+            "store_prefetch_hits", "store_sync_fetches",
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)

@@ -1,0 +1,304 @@
+"""Tiered out-of-core store: eviction order, watermark invariants, CRC
+re-reads on real on-disk bit-flips, segment-level resume, and the
+bit-equality of a spilling TeraSort against its all-in-HBM control."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.hbm.tiered_store import TieredStore, store_totals
+from sparkrdma_tpu.obs.metrics import global_registry
+
+
+def _conf(tmp_path, watermark, prefetch=2, **kw):
+    return ShuffleConf(spill_tier_dir=str(tmp_path / "tier"),
+                       spill_tier_host_bytes=watermark,
+                       spill_tier_prefetch=prefetch, **kw)
+
+
+def _arr(rng, nbytes):
+    return rng.integers(0, 2**32, size=(nbytes // 4,), dtype=np.uint32)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_lru_eviction_order(tmp_path, rng):
+    """The writer evicts the LEAST recently used unpinned segment: a get
+    refreshes recency, so the untouched segment goes to disk first."""
+    seg = 1024
+    store = TieredStore(_conf(tmp_path, watermark=2 * seg))
+    try:
+        a, b, c = (_arr(rng, seg) for _ in range(3))
+        store.put("a", a)
+        store.put("b", b)
+        np.testing.assert_array_equal(store.get("a"), a)  # a becomes MRU
+        store.put("c", c)                                 # over watermark
+        store.drain()
+        assert store.tier_of("b") == "disk"               # LRU victim
+        assert store.tier_of("a") == "host"
+        assert store.tier_of("c") == "host"
+        assert store.occupancy()["host_bytes"] <= 2 * seg
+        # disk round-trip is bit-exact
+        np.testing.assert_array_equal(store.get("b"), b)
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_pinned_segments_never_evict(tmp_path, rng):
+    seg = 1024
+    store = TieredStore(_conf(tmp_path, watermark=seg // 2))
+    try:
+        a, b = _arr(rng, seg), _arr(rng, seg)
+        store.put("a", a, pin=True)
+        store.put("b", b)
+        store.drain()
+        assert store.tier_of("a") == "host"
+        assert store.tier_of("b") == "disk"
+        store.unpin("a")
+        store.drain()
+        assert store.tier_of("a") == "disk"
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_watermark_property_random_ops(tmp_path):
+    """Property check: under a random put/get/delete workload the drained
+    host occupancy never exceeds the watermark, and every surviving
+    segment reads back bit-exact from whatever tier it landed in."""
+    rng = np.random.default_rng(7)
+    watermark = 8 * 1024
+    store = TieredStore(_conf(tmp_path, watermark=watermark))
+    live = {}
+    try:
+        for i in range(120):
+            op = rng.integers(0, 10)
+            if op < 5 or not live:
+                key = f"k{i}"
+                data = _arr(rng, int(rng.integers(1, 9)) * 512)
+                store.put(key, data)
+                live[key] = data
+            elif op < 8:
+                key = str(rng.choice(sorted(live)))
+                np.testing.assert_array_equal(store.get(key), live[key])
+            else:
+                key = str(rng.choice(sorted(live)))
+                store.delete(key)
+                del live[key]
+            if i % 20 == 19:
+                store.drain()
+                assert store.occupancy()["host_bytes"] <= watermark
+        store.drain()
+        occ = store.occupancy()
+        assert occ["host_bytes"] <= watermark
+        assert occ["host_segments"] + occ["disk_segments"] == len(live)
+        for key, data in live.items():
+            np.testing.assert_array_equal(store.get(key), data)
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_no_disk_tier_degrades_to_host_resident(tmp_path, rng):
+    """Without a disk root, eviction refuses cleanly: data stays
+    host-resident over the watermark instead of being dropped."""
+    conf = ShuffleConf(spill_tier_dir="", spill_dir="",
+                       spill_tier_host_bytes=512)
+    store = TieredStore(conf)
+    try:
+        a = _arr(rng, 2048)
+        store.put("a", a)
+        store.drain()
+        assert store.tier_of("a") == "host"
+        np.testing.assert_array_equal(store.get("a"), a)
+    finally:
+        store.close()
+
+
+def _flip_payload_byte(path):
+    """A REAL on-disk bit flip in the payload region (not the trailer)."""
+    with open(path, "r+b") as f:
+        f.seek(3)
+        byte = f.read(1)
+        f.seek(3)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return byte
+
+
+def test_crc_persistent_corruption_raises(tmp_path, rng):
+    seg = 1024
+    store = TieredStore(_conf(tmp_path, watermark=0,
+                              spill_tier_reread_attempts=3))
+    base = global_registry().counter("store.crc_rereads").value
+    try:
+        a = _arr(rng, seg)
+        store.put("a", a)
+        store.drain()
+        assert store.tier_of("a") == "disk"
+        _flip_payload_byte(os.path.join(store.root, "a.seg"))
+        with pytest.raises(OSError, match="unreadable after 3 attempts"):
+            store.get("a")
+        # bounded: attempts-1 re-reads, then give up
+        assert global_registry().counter(
+            "store.crc_rereads").value - base == 2
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_crc_transient_corruption_rereads(tmp_path, rng, monkeypatch):
+    """First read hits a real on-disk bit flip and fails CRC; the file
+    heals before the bounded re-read, which succeeds and is accounted as
+    a ``spill_reread`` recovery."""
+    import sparkrdma_tpu.hbm.tiered_store as ts_mod
+
+    seg = 1024
+    store = TieredStore(_conf(tmp_path, watermark=0,
+                              spill_tier_reread_attempts=3))
+    reg = global_registry()
+    base_reread = reg.counter("store.crc_rereads").value
+    base_recover = reg.counter("recover.spill_reread").value
+    try:
+        a = _arr(rng, seg)
+        store.put("a", a)
+        store.drain()
+        path = os.path.join(store.root, "a.seg")
+        good = open(path, "rb").read()
+        _flip_payload_byte(path)
+
+        real = ts_mod.read_array
+        calls = {"n": 0}
+
+        def healing(p, dtype, shape, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:       # the medium heals between attempts
+                with open(path, "wb") as f:
+                    f.write(good)
+            return real(p, dtype, shape, **kw)
+
+        monkeypatch.setattr(ts_mod, "read_array", healing)
+        np.testing.assert_array_equal(store.get("a"), a)
+        assert calls["n"] == 2
+        assert reg.counter("store.crc_rereads").value - base_reread == 1
+        assert reg.counter(
+            "recover.spill_reread").value - base_recover == 1
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_prefetch_promotes_and_counts_hits(tmp_path, rng):
+    seg = 1024
+    # watermark holds lookahead+2 segments so promotion does not thrash
+    store = TieredStore(_conf(tmp_path, watermark=4 * seg, prefetch=2))
+    try:
+        data = {f"k{i}": _arr(rng, seg) for i in range(6)}
+        for k, v in data.items():
+            store.put(k, v)
+        store.drain()
+        on_disk = [k for k in sorted(data) if store.tier_of(k) == "disk"]
+        assert on_disk
+        base = store_totals()
+        store.prefetch(on_disk[:2])
+        for k in on_disk[:2]:
+            np.testing.assert_array_equal(store.get(k), data[k])
+        d = tuple(b - a for a, b in zip(base, store_totals()))
+        assert d[2] == 2     # prefetch_hits
+        assert d[3] == 0     # sync_fetches
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_sync_fetch_counted_without_prefetch(tmp_path, rng):
+    seg = 1024
+    store = TieredStore(_conf(tmp_path, watermark=0, prefetch=0))
+    try:
+        a = _arr(rng, seg)
+        store.put("a", a)
+        store.drain()
+        assert store.tier_of("a") == "disk"
+        base = store_totals()
+        np.testing.assert_array_equal(store.get("a"), a)
+        d = tuple(b - a for a, b in zip(base, store_totals()))
+        assert d[3] == 1 and d[2] == 0
+    finally:
+        store.close(delete_disk=True)
+
+
+# ----------------------------------------------------------------------
+# segment-level checkpoint resume + end-to-end bit-equality
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def manager(tmp_path_factory):
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    root = tmp_path_factory.mktemp("tiered_mgr")
+    conf = ShuffleConf(slot_records=256,
+                       spill_dir=str(root / "spill"),
+                       spill_tier_dir=str(root / "tier"),
+                       spill_tier_host_bytes=64 * 1024,
+                       spill_tier_prefetch=2)
+    m = ShuffleManager(conf=conf)
+    yield m
+    m.stop()
+
+
+def test_resume_replays_only_missing_segments(manager, rng):
+    from sparkrdma_tpu.exchange.protocol import ShufflePlan
+
+    mesh = manager.runtime.num_partitions
+    chunks = {f"rs.chunk{j}": rng.integers(0, 2**32, size=(4, 256),
+                                           dtype=np.uint32)
+              for j in range(4)}
+    plan = ShufflePlan(counts=np.zeros((mesh, mesh), np.int64),
+                       num_rounds=1, out_capacity=32, capacity=32,
+                       split_factor=1)
+    manager.checkpoint_segments(77, list(chunks.items()), plan, mesh)
+    for k, v in chunks.items():
+        manager.tiered.put(k, v)
+    # lose two segments; resume must adopt exactly those, lazily
+    manager.tiered.delete("rs.chunk1")
+    manager.tiered.delete("rs.chunk3")
+    adopted = manager.resume_segments(77)
+    assert sorted(adopted) == ["rs.chunk1", "rs.chunk3"]
+    for k in adopted:
+        assert manager.tiered.tier_of(k) == "disk"   # not read yet
+    for k, v in chunks.items():
+        np.testing.assert_array_equal(manager.tiered.get(k), v)
+    # second resume: nothing is missing any more
+    assert manager.resume_segments(77) == []
+    for k in chunks:
+        manager.tiered.delete(k)
+
+
+def test_tiered_terasort_bit_equal_to_in_hbm(manager, rng):
+    """The acceptance property: an out-of-core run whose map output
+    spills to disk produces a BIT-IDENTICAL sorted stream to the
+    all-in-HBM control (full-record total order is unique)."""
+    from sparkrdma_tpu.workloads.streaming import _canon, run_tiered_terasort
+
+    W, C = 4, 1024
+    n_chunks = 8
+    cols = rng.integers(0, 2**32, size=(W, n_chunks * C), dtype=np.uint32)
+
+    # control: watermark >> dataset, nothing spills
+    manager.tiered._watermark = 1 << 30
+    control = run_tiered_terasort(manager, cols, chunk_records=C,
+                                  shuffle_id_base=9600)
+    assert control.store_stats[0] == 0        # no spill bytes
+
+    # tiered: watermark holds lookahead+2 chunks -> spills + prefetches
+    manager.tiered._watermark = 4 * W * C * 4
+    tiered = run_tiered_terasort(manager, cols, chunk_records=C,
+                                 shuffle_id_base=9700)
+    manager.tiered._watermark = manager.conf.spill_tier_host_bytes
+    spill, fetch, hits, sync = tiered.store_stats
+    assert spill > 0 and fetch > 0            # the run really went to disk
+    assert hits >= n_chunks - 2               # prefetch mostly hides disk
+    assert sync <= 2
+    assert tiered.records == control.records == n_chunks * C
+    np.testing.assert_array_equal(tiered.rows, control.rows)
+    np.testing.assert_array_equal(
+        control.rows, _canon(np.ascontiguousarray(cols.T)))
