@@ -6,9 +6,12 @@ checks (test importability, slow markers, journal schema sync, fault
 site sync). Those four now live as registered rules in
 ``sparkrdma_tpu/lint`` alongside the newer AST rules (config-key sync,
 counter-name sync, timeline pairing, guarded-by discipline, assert
-safety, never-raise I/O); this shim runs the *full* rule set so the
-tier-1 command from ROADMAP.md keeps working unchanged while enforcing
-everything.
+safety, never-raise I/O) and the interprocedural concurrency rules
+(lock-order, blocking-under-lock, guarded-by-inference,
+condition-wait-loop, thread-lifecycle — call-graph + lock-model
+analysis from ``sparkrdma_tpu/lint/rules_concurrency.py``); this shim
+runs the *full* rule set so the tier-1 command from ROADMAP.md keeps
+working unchanged while enforcing everything.
 
 Output shape and exit codes are preserved from the original: failures
 go to stderr as ``check_markers: N failure(s)`` followed by one

@@ -6,6 +6,7 @@ Usage:
     python scripts/srlint.py --list-rules    # one rule per line
     python scripts/srlint.py --select a,b    # only the named rules
     python scripts/srlint.py --json          # machine-readable findings
+    python scripts/srlint.py --select lock-order --dot   # DOT lock graph
 
 Exit code 0 when no finding survives suppression, 1 otherwise (2 for
 usage errors such as an unknown rule id). Human output is one
@@ -44,6 +45,9 @@ def main(argv=None) -> int:
                     help="emit findings as JSON")
     ap.add_argument("--root", default=str(REPO),
                     help="repo root to lint (default: this repo)")
+    ap.add_argument("--dot", action="store_true",
+                    help="print the lock acquisition graph as Graphviz "
+                         "DOT on stdout (findings go to stderr)")
     args = ap.parse_args(argv)
 
     rules = all_rules()
@@ -69,6 +73,12 @@ def main(argv=None) -> int:
             return 2
 
     findings = run_rules(args.root, select=select)
+    if args.dot:
+        from sparkrdma_tpu.lint.rules_concurrency import render_lock_dot
+        print(render_lock_dot(args.root))
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        return 1 if findings else 0
     if args.as_json:
         print(json.dumps({
             "root": str(args.root),

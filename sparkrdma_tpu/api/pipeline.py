@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from queue import Queue
+from queue import Empty, Queue
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -200,7 +200,16 @@ def encode_rows_to_device(manager, keys: np.ndarray, payloads: Sequence,
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                # bounded wait: if the producer dies without posting its
+                # exception (killed thread, interpreter teardown) the
+                # consumer must not hang forever on an empty queue
+                item = q.get(timeout=30.0)
+            except Empty:
+                if not t.is_alive():
+                    raise RuntimeError(
+                        "serde-encode producer died without a result")
+                continue
             if item is None:
                 break
             if isinstance(item, BaseException):

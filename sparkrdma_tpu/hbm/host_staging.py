@@ -356,6 +356,12 @@ def load_native(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
                 # that). A failed make — no toolchain — still falls
                 # through to loading whatever prebuilt library exists.
                 try:
+                    # _lib_lock exists precisely to serialize this
+                    # one-shot build+dlopen: concurrent first callers
+                    # must block until the single build finishes, and
+                    # the result is cached so the lock is never held
+                    # for the build again. Leaf lock by design.
+                    # srlint: ignore[blocking-under-lock]
                     subprocess.run(
                         ["make", "-C", str(_NATIVE_DIR), flavor or "all"],
                         check=True, capture_output=True, timeout=120,
@@ -544,13 +550,25 @@ class SpillWriter:
             import queue as _q
 
             self._fb_q: "_q.Queue" = _q.Queue(maxsize=depth)
-            self._fb_errors = 0
+            self._fb_lock = threading.Lock()
+            self._fb_errors = 0                # guarded-by: _fb_lock
+            self._fb_stop = False              # guarded-by: _fb_lock
             self._fb = threading.Thread(target=self._fb_loop, daemon=True)
             self._fb.start()
 
     def _fb_loop(self) -> None:
+        import queue as _q
         while True:
-            item = self._fb_q.get()
+            try:
+                # bounded wait so a lost sentinel (e.g. an interpreter
+                # tearing down mid-close) cannot park this thread
+                # forever; the stop flag is the durable exit signal
+                item = self._fb_q.get(timeout=1.0)
+            except _q.Empty:
+                with self._fb_lock:
+                    if self._fb_stop:
+                        return
+                continue
             if item is None:
                 self._fb_q.task_done()
                 return
@@ -558,7 +576,8 @@ class SpillWriter:
             try:
                 arr.tofile(path)
             except OSError:
-                self._fb_errors += 1
+                with self._fb_lock:
+                    self._fb_errors += 1
             self._fb_q.task_done()
 
     def submit(self, path: str, arr: np.ndarray) -> None:
@@ -598,8 +617,9 @@ class SpillWriter:
             errors = int(self._lib.sr_spooler_drain(self._handle))
         else:
             self._fb_q.join()
-            errors = self._fb_errors
-            self._fb_errors = 0
+            with self._fb_lock:
+                errors = self._fb_errors
+                self._fb_errors = 0
         self._pending.clear()
         self._release_leases()
         return errors
@@ -615,6 +635,8 @@ class SpillWriter:
             self._lib.sr_spooler_destroy(self._handle)
             self._handle = None
         elif self._fb is not None:
+            with self._fb_lock:
+                self._fb_stop = True
             self._fb_q.put(None)
             self._fb.join(timeout=10)
             self._fb = None

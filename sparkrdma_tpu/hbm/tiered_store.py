@@ -168,17 +168,22 @@ class TieredStore:
         lease = self.host_pool.get(arr.nbytes)
         lease.view(arr.dtype, arr.shape)[...] = arr
         seg.lease = lease
+        old_ev, defer_old = None, False
         with self._lock:
             if self._closed:
                 raise RuntimeError("TieredStore is closed")
             old = self._segments.pop(key, None)
+            if old is not None:
+                old_ev, defer_old = self._drop_locked(old)
             self._tick += 1
             seg.tick = self._tick
             seg.pinned = pin
             self._segments[key] = seg
             self._host_bytes += seg.nbytes
             over = self._host_bytes > self._watermark
-        if old is not None:
+        if old_ev is not None:
+            old_ev.set()
+        if old is not None and not defer_old:
             self._discard(old)
         reg = _reg()
         reg.counter("store.puts").inc()
@@ -207,10 +212,15 @@ class TieredStore:
             tier = seg.tier
             ev = seg.event
             if tier == "host":
-                if seg.promoted:
-                    seg.promoted = False
-                    _reg().counter("store.prefetch_hits").inc()
-                return np.array(seg.lease.view(seg.dtype, seg.shape))
+                hit = seg.promoted
+                seg.promoted = False
+                # the copy must happen under _lock (eviction can release
+                # the lease the moment we let go) — the counter must not
+                data = np.array(seg.lease.view(seg.dtype, seg.shape))
+        if tier == "host":
+            if hit:
+                _reg().counter("store.prefetch_hits").inc()
+            return data
         if ev is not None:
             # promotion in flight: ride it (the disk read overlapped)
             ev.wait()
@@ -222,8 +232,12 @@ class TieredStore:
                     raise seg.error
                 if seg.tier == "host":
                     seg.promoted = False
-                    _reg().counter("store.prefetch_hits").inc()
-                    return np.array(seg.lease.view(seg.dtype, seg.shape))
+                    data = np.array(seg.lease.view(seg.dtype, seg.shape))
+                else:
+                    data = None
+            if data is not None:
+                _reg().counter("store.prefetch_hits").inc()
+                return data
         # synchronous fetch: the consumer is blocked on disk right now
         _reg().counter("store.sync_fetches").inc()
         record_active("spill:fetch", key=key, sync=True)
@@ -279,15 +293,20 @@ class TieredStore:
         seg = _Segment(key, shape, dtype, nbytes)
         seg.tier = "disk"
         seg.path = path
+        old_ev, defer_old = None, False
         with self._lock:
             if self._closed:
                 raise RuntimeError("TieredStore is closed")
             old = self._segments.pop(key, None)
+            if old is not None:
+                old_ev, defer_old = self._drop_locked(old)
             self._tick += 1
             seg.tick = self._tick
             self._segments[key] = seg
             self._disk_bytes += nbytes
-        if old is not None:
+        if old_ev is not None:
+            old_ev.set()
+        if old is not None and not defer_old:
             self._discard(old)
         self._set_gauges()
 
@@ -354,7 +373,15 @@ class TieredStore:
     # ------------------------------------------------------------------
     def _writer_loop(self) -> None:
         while True:
-            item = self._wq.get()
+            try:
+                # bounded wait: a lost sentinel must not park the writer
+                # forever — the closed flag is the durable exit signal
+                item = self._wq.get(timeout=1.0)
+            except queue.Empty:
+                with self._lock:
+                    if self._closed:
+                        return
+                continue
             if item is None:
                 self._wq.task_done()
                 return
@@ -386,9 +413,18 @@ class TieredStore:
                             pool=self.host_pool)
             except OSError:
                 # disk refused (no tier configured / full): leave the
-                # segment host-resident; data is never dropped
+                # segment host-resident; data is never dropped — unless
+                # a concurrent put/delete already dropped it, in which
+                # case the lease was deferred to us and we release it
                 with self._lock:
                     seg.pinned = False
+                    gone = self._segments.get(seg.key) is not seg
+                    if gone:
+                        lease, seg.lease = seg.lease, None
+                    else:
+                        lease = None
+                if lease is not None:
+                    lease.release()
                 return
             orphan = None
             with self._lock:
@@ -407,15 +443,20 @@ class TieredStore:
                     self._host_bytes -= seg.nbytes
                     self._disk_bytes += seg.nbytes
                 else:
-                    lease = None
+                    # replaced or deleted mid-write: the dropper saw
+                    # pinned and deferred the lease to us (we were
+                    # reading it outside the lock); the file we just
+                    # wrote holds stale data for this key
+                    lease, seg.lease = seg.lease, None
+                    orphan = path
+            if lease is not None:
+                lease.release()
             if orphan is not None:
                 try:
                     os.remove(orphan)
                 except OSError:
                     pass
                 continue
-            if lease is not None:
-                lease.release()
             reg = _reg()
             reg.counter("store.spill_writes").inc()
             reg.counter("store.spill_bytes").inc(seg.nbytes)
@@ -426,7 +467,13 @@ class TieredStore:
         from sparkrdma_tpu.obs.timeline import record_active
 
         while True:
-            key = self._pq.get()
+            try:
+                key = self._pq.get(timeout=1.0)
+            except queue.Empty:
+                with self._lock:
+                    if self._closed:
+                        return
+                continue
             if key is None:
                 self._pq.task_done()
                 return
@@ -494,8 +541,25 @@ class TieredStore:
                 self._host_bytes -= seg.nbytes
             else:
                 self._disk_bytes -= seg.nbytes
-        self._discard(seg)
+            ev, defer = self._drop_locked(seg)
+        if ev is not None:
+            ev.set()
+        if not defer:
+            self._discard(seg)
         self._set_gauges()
+
+    def _drop_locked(self, seg: _Segment):
+        """Detach ``seg`` as it leaves ``_segments`` (caller holds
+        ``_lock``). Returns ``(event, defer)``: the promotion event to
+        set once the lock is released — a ``get`` riding it would
+        otherwise park forever on a segment nobody will promote — and
+        whether lease cleanup must be deferred to the eviction writer
+        (``pinned`` means the writer is reading ``seg.lease`` outside
+        the lock right now; releasing it here would hand the buffer to
+        a new lease mid-read)."""
+        ev, seg.event = seg.event, None
+        defer = seg.pinned and seg.tier == "host" and seg.lease is not None
+        return ev, defer
 
     def _discard(self, seg: _Segment) -> None:
         if seg.lease is not None:
@@ -513,8 +577,11 @@ class TieredStore:
 
         reg = global_registry()
         with self._lock:
-            reg.gauge("store.host_bytes").set(self._host_bytes)
-            reg.gauge("store.disk_bytes").set(self._disk_bytes)
+            host_bytes, disk_bytes = self._host_bytes, self._disk_bytes
+        # gauge writes take the registry's own lock — keep them out of
+        # _lock so the store's critical section stays lock-leaf
+        reg.gauge("store.host_bytes").set(host_bytes)
+        reg.gauge("store.disk_bytes").set(disk_bytes)
 
     def drain(self) -> None:
         """Block until every queued eviction poke and prefetch has been
@@ -534,12 +601,16 @@ class TieredStore:
             self._segments.clear()
             self._host_bytes = 0
             self._disk_bytes = 0
+            dropped = [self._drop_locked(s) for s in segs]
+        for ev, _defer in dropped:
+            if ev is not None:
+                ev.set()
         self._wq.put(None)
         self._pq.put(None)
         self._writer.join(timeout=10)
         self._prefetcher.join(timeout=10)
-        for seg in segs:
-            if seg.lease is not None:
+        for seg, (_ev, defer) in zip(segs, dropped):
+            if seg.lease is not None and not defer:
                 seg.lease.release()
                 seg.lease = None
             if delete_disk and seg.path is not None \

@@ -39,6 +39,7 @@ from sparkrdma_tpu.lint import rules_tests    # noqa: F401  (registration)
 from sparkrdma_tpu.lint import rules_sync     # noqa: F401
 from sparkrdma_tpu.lint import rules_timeline  # noqa: F401
 from sparkrdma_tpu.lint import rules_safety   # noqa: F401
+from sparkrdma_tpu.lint import rules_concurrency  # noqa: F401
 
 __all__ = ["Finding", "LintContext", "Rule", "all_rules", "get_rule",
            "rule", "run_rules"]

@@ -90,6 +90,15 @@ class LintContext:
     def __init__(self, root):
         self.root = Path(root).resolve()
         self._cache: Dict[str, Optional[SourceFile]] = {}
+        self._memo: Dict[str, object] = {}
+
+    def memo(self, key: str, builder: Callable[["LintContext"], object]):
+        """Build-once cache for cross-rule analyses (call graph, lock
+        model): the first rule to ask pays the build, the rest reuse it
+        — this is what keeps the five concurrency rules one AST pass."""
+        if key not in self._memo:
+            self._memo[key] = builder(self)
+        return self._memo[key]
 
     def file(self, rel: str) -> Optional[SourceFile]:
         """The file at ``rel`` (repo-relative), or None when absent."""

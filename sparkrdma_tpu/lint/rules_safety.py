@@ -4,9 +4,13 @@ never-raise exception contracts.
 ``guarded-by`` is annotation-driven: a comment ``# guarded-by: <lock>``
 on the line that first assigns an attribute (or module global) declares
 which lock protects it, and every other access must sit lexically
-inside ``with self.<lock>:`` / ``with <lock>:``. ``__init__`` and
-methods whose names end in ``_locked`` (the repo's caller-holds-lock
-convention) are exempt. The walk is an AST scope walk — receiver,
+inside ``with self.<lock>:`` / ``with <lock>:``. The named guard may be
+a ``threading.Lock``, ``RLock``, or ``Condition`` — and when the lock
+model (:mod:`sparkrdma_tpu.lint.locks`) sees ``cond =
+threading.Condition(lock)``, holding either name counts as holding the
+other, since they are the same mutex. ``__init__`` and methods whose
+names end in ``_locked`` (the repo's caller-holds-lock convention) are
+exempt. The walk is an AST scope walk — receiver,
 enclosing class, enclosing function, and the stack of held locks are
 all tracked structurally, not by regex.
 
@@ -25,6 +29,7 @@ import re
 from typing import Dict, List, Set, Tuple
 
 from sparkrdma_tpu.lint.core import Finding, LintContext, SourceFile, rule
+from sparkrdma_tpu.lint.locks import build_lock_models
 
 # ---------------------------------------------------------------------
 # guarded-by
@@ -110,13 +115,28 @@ def _exempt(func: str) -> bool:
 
 @rule("guarded-by",
       "attributes annotated '# guarded-by: <lock>' are only accessed "
-      "under 'with <lock>:'")
+      "under 'with <lock>:' (Lock/RLock/Condition; a Condition guards "
+      "through its own lock and vice versa)")
 def check_guarded_by(ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
+    models = build_lock_models(ctx)
     for sf in ctx.package_files():
         attrs, globals_ = _guard_decls(sf)
         if not attrs and not globals_:
             continue
+        model = models.get(sf.rel)
+        alias_groups = model.alias_groups() if model is not None else {}
+
+        def held_names(node, cls) -> Set[str]:
+            """Names a ``with`` acquires, closed over Condition aliases:
+            ``with self._cond:`` where ``_cond = Condition(self._lock)``
+            holds both ``_cond`` and ``_lock``."""
+            out = _with_locks(node)
+            for scope in (cls, None):
+                groups = alias_groups.get(scope, {})
+                for n in list(out):
+                    out |= groups.get(n, set())
+            return out
 
         def enforce(node, cls, func, locks):
             if isinstance(node, ast.ClassDef):
@@ -128,7 +148,7 @@ def check_guarded_by(ctx: LintContext) -> List[Finding]:
                     enforce(child, cls, node.name, locks)
                 return
             if isinstance(node, (ast.With, ast.AsyncWith)):
-                locks = locks | _with_locks(node)
+                locks = locks | held_names(node, cls)
             if isinstance(node, ast.Attribute) \
                     and isinstance(node.value, ast.Name) \
                     and node.value.id == "self" and cls:

@@ -157,7 +157,7 @@ class RollupAggregator:
                and lat_ms > LATENCY_BOUNDS_MS[b]):
             b += 1
         with self._lock:
-            self._roll_locked(now)
+            pending = self._roll_locked(now)
             cell = self._cells.get(span.shuffle_id)
             if cell is None:
                 cell = self._cells[span.shuffle_id] = _Cell()
@@ -199,23 +199,39 @@ class RollupAggregator:
             cell.lat_sum_ms += lat_ms
             if lat_ms > cell.lat_max_ms:
                 cell.lat_max_ms = lat_ms
+        # journal emission does its own file I/O under its own lock —
+        # it must happen after _lock is dropped (blocking-under-lock)
+        for d in pending:
+            self._journal.emit_raw(d)
 
     def flush(self, now: Optional[float] = None) -> None:
         """Emit every open cell (shutdown / test hook)."""
         now = self._clock() if now is None else now
         with self._lock:
-            self._emit_locked(now)
+            pending = self._drain_locked(now)
+        for d in pending:
+            self._journal.emit_raw(d)
 
-    def _roll_locked(self, now: float) -> None:
+    def _roll_locked(self, now: float) -> List[Dict]:
+        """Advance the window; returns drained lines to emit once the
+        caller has released ``_lock``."""
         start = (now // self.window_s) * self.window_s \
             if self.window_s > 0 else now
         if self._window_start is None:
             self._window_start = start
-        elif start > self._window_start:
-            self._emit_locked(now)
-            self._window_start = start
+            return []
+        if start <= self._window_start:
+            return []
+        pending = self._drain_locked(now)
+        self._window_start = start
+        return pending
 
-    def _emit_locked(self, now: float) -> None:
+    def _drain_locked(self, now: float) -> List[Dict]:
+        """Snapshot every open cell into finished rollup lines and
+        clear them. Pure in-memory work: the caller emits the returned
+        lines *outside* ``_lock`` so slow journal I/O never extends the
+        aggregator's critical section."""
+        pending: List[Dict] = []
         for sid in sorted(self._cells):
             c = self._cells[sid]
             d = {
@@ -267,9 +283,10 @@ class RollupAggregator:
                 raise RuntimeError(
                     "rollup line drifted from ROLLUP_FIELDS: "
                     f"{sorted(set(d) ^ ROLLUP_FIELDS)}")
-            self._journal.emit_raw(d)
+            pending.append(d)
             self.emitted += 1
         self._cells.clear()
+        return pending
 
 
 def rss_mb() -> Optional[float]:   # never-raises
@@ -318,8 +335,11 @@ class HeartbeatEmitter:
         self._started_at = clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.seq = 0
-        self.beat_errors = 0
+        # beat() runs on both the background thread and foreground
+        # callers (tests, the final beat in stop())
+        self._lock = threading.Lock()
+        self.seq = 0                                 # guarded-by: _lock
+        self.beat_errors = 0                         # guarded-by: _lock
 
     def start(self) -> None:
         if self._thread is not None or self.interval_s <= 0:
@@ -344,12 +364,14 @@ class HeartbeatEmitter:
     def beat(self, now: Optional[float] = None) -> None:   # never-raises
         try:
             now = self._clock() if now is None else now
-            self.seq += 1
+            with self._lock:
+                self.seq += 1
+                seq = self.seq
             d = {
                 "kind": "heartbeat",
                 "schema": SCHEMA_VERSION,
                 "ts": now,
-                "seq": self.seq,
+                "seq": seq,
                 "process_index": self._identity.get("process_index", 0),
                 "host_count": self._identity.get("host_count", 1),
                 "host": self._identity.get(
@@ -373,8 +395,10 @@ class HeartbeatEmitter:
         except Exception:
             # liveness reporting must never take down the process it
             # reports on; the error count is itself the diagnostic
-            self.beat_errors += 1
-            if self.beat_errors == 1:
+            with self._lock:
+                self.beat_errors += 1
+                first = self.beat_errors == 1
+            if first:
                 log.exception("heartbeat emission failed")
 
     def stop(self, final_beat: bool = True) -> None:

@@ -1,6 +1,6 @@
 """Child-process body for the sanitizer test legs.
 
-Run as ``python tests/sanitizer_worker.py {probe|fuzz}`` with
+Run as ``python tests/sanitizer_worker.py {probe|fuzz|planes}`` with
 ``SPARKRDMA_NATIVE_FLAVOR=tsan|asan`` set and the matching sanitizer
 runtime LD_PRELOADed — ``tests/test_sanitizers.py`` does both. The
 point of a separate script (deliberately NOT named ``test_*.py``, so
@@ -15,6 +15,12 @@ parent can skip (not fail) on machines without sanitizer runtimes.
 (thread counts 1/2/8, degenerate batches, error paths, decode-plan
 validation) plus the CRC/decompress corruption paths, which is where
 a data race or heap overflow in ``native/staging.cpp`` would surface.
+``planes`` churns the long-lived Python thread planes — the tiered
+store's writer/prefetcher (concurrent put/fetch/prefetch/evict with
+wanted-flag races, spill I/O through the instrumented native file
+path), StallWatchdog arm/disarm, HeartbeatEmitter start/stop — under
+TSan, so a race between foreground callers and the background threads
+surfaces as a sanitizer report instead of a once-a-week flake.
 
 Exit codes: 0 ok, 3 native codec unavailable (parent skips), anything
 else — including a sanitizer runtime's own failure exit — fails the leg.
@@ -151,6 +157,142 @@ def _staging_fuzz(hs, np) -> None:
             raise AssertionError("bit-flipped spill read OK")
 
 
+def _store_plane(np) -> None:
+    """TieredStore writer/prefetcher under concurrent foreground churn.
+
+    A tiny watermark forces constant eviction while four churn threads
+    put / get / prefetch / delete overlapping keys — the exact
+    wanted-flag race window the store's eviction protocol exists for.
+    Every successful get must return the bit-exact original array."""
+    import threading
+
+    from sparkrdma_tpu.config import ShuffleConf
+    from sparkrdma_tpu.hbm.tiered_store import TieredStore
+
+    with tempfile.TemporaryDirectory() as td:
+        conf = ShuffleConf(spill_tier_dir=td,
+                           spill_tier_host_bytes=1 << 15,
+                           spill_tier_prefetch=4)
+        store = TieredStore(conf)
+        n_keys = 24
+        arrays = {
+            f"k{i}": np.arange(i * 31, i * 31 + 512,
+                               dtype=np.uint32).reshape(64, 8)
+            for i in range(n_keys)
+        }
+        for k, a in arrays.items():
+            store.put(k, a)
+        errors: list = []
+
+        def churn(seed: int) -> None:
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(120):
+                    k = f"k{int(rng.integers(n_keys))}"
+                    op = int(rng.integers(8))
+                    if op <= 2:
+                        try:
+                            got = store.get(k)
+                            assert (got == arrays[k]).all(), \
+                                f"corrupt read of {k}"
+                        except KeyError:
+                            pass     # deleted by a sibling; re-put below
+                        except OSError:
+                            pass     # sibling delete unlinked the spill
+                                     # file mid-read; re-put below
+                    elif op <= 4:
+                        store.put(k, arrays[k])
+                    elif op == 5:
+                        store.prefetch(
+                            [k, f"k{int(rng.integers(n_keys))}"])
+                    elif op == 6:
+                        store.service()
+                    else:
+                        store.delete(k)
+                        store.put(k, arrays[k])
+            except Exception as e:   # surfaced after join
+                errors.append(e)
+
+        workers = [threading.Thread(target=churn, args=(100 + i,),
+                                    name=f"store-churn-{i}")
+                   for i in range(4)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        if errors:
+            raise errors[0]
+        store.drain()
+        for k in store.keys():
+            assert (store.get(k) == arrays[k]).all(), f"corrupt {k}"
+        occ = store.occupancy()
+        assert occ["host_bytes"] >= 0 and occ["disk_bytes"] >= 0
+        store.close(delete_disk=True)
+
+
+def _watchdog_plane(np) -> None:
+    """StallWatchdog arm/disarm churn racing the timer thread: short
+    enough timeouts that some timers genuinely fire mid-churn while
+    set_context rewrites the shared context under them."""
+    import threading
+    import time as _time
+
+    from sparkrdma_tpu.obs.watchdog import StallWatchdog, dump_armed
+
+    wd = StallWatchdog(timeout_s=0.002)
+
+    def churn(seed: int) -> None:
+        for i in range(60):
+            wd.set_context(span_id=f"s{seed}", read=i)
+            with wd.armed("planes-churn", shuffle_id=seed, chunk=i):
+                if i % 7 == 0:
+                    _time.sleep(0.004)   # let some timers actually fire
+        dump_armed(sink=lambda _s: None)
+
+    workers = [threading.Thread(target=churn, args=(i,),
+                                name=f"wd-churn-{i}") for i in range(4)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    with wd._lock:
+        assert wd.stall_count >= 1, "no timer ever fired during churn"
+
+
+def _heartbeat_plane(np) -> None:
+    """HeartbeatEmitter start/stop with the beat thread live: foreground
+    beats race the background ones on seq/beat_errors, probes race
+    stop()."""
+    import threading
+    import time as _time
+
+    from sparkrdma_tpu.obs.rollup import HeartbeatEmitter
+
+    class _Sink:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.lines: list = []      # guarded-by: _lock
+
+        def emit_raw(self, d):
+            with self._lock:
+                self.lines.append(dict(d))
+
+    for _round in range(3):
+        sink = _Sink()
+        hb = HeartbeatEmitter(sink, interval_s=0.002,
+                              probes={"in_flight": lambda: 1})
+        hb.start()
+        for _ in range(10):
+            hb.beat()                  # foreground beats race _run's
+        _time.sleep(0.01)
+        hb.stop()
+        with hb._lock:
+            assert hb.beat_errors == 0, "heartbeat beats failed"
+            assert hb.seq >= 11
+        with sink._lock:
+            assert all(d["kind"] == "heartbeat" for d in sink.lines)
+
+
 def main(mode: str) -> int:
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -187,7 +329,16 @@ def main(mode: str) -> int:
               f"(flavor={hs.native_flavor() or 'plain'})")
         return 0
 
-    print(f"unknown mode {mode!r} (expected probe|fuzz)", file=sys.stderr)
+    if mode == "planes":
+        _store_plane(np)
+        _watchdog_plane(np)
+        _heartbeat_plane(np)
+        print("sanitizer worker: planes ok "
+              f"(flavor={hs.native_flavor() or 'plain'})")
+        return 0
+
+    print(f"unknown mode {mode!r} (expected probe|fuzz|planes)",
+          file=sys.stderr)
     return 2
 
 

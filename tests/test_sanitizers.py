@@ -62,7 +62,7 @@ def _run_worker(flavor: str, runtime: str, mode: str, timeout: int):
                           cwd=str(REPO), timeout=timeout)
 
 
-def _leg(flavor: str, report_marker: str) -> None:
+def _leg(flavor: str, report_marker: str, mode: str = "fuzz") -> None:
     runtime = _runtime_path(f"lib{flavor}.so")
     if runtime is None:
         pytest.skip(f"skipped: no sanitizer toolchain (lib{flavor}.so "
@@ -76,13 +76,13 @@ def _leg(flavor: str, report_marker: str) -> None:
             pytest.fail(f"sanitizer report during {flavor} probe:\n{blurb}")
         pytest.skip("skipped: no sanitizer toolchain (probe exited "
                     f"{probe.returncode}: {blurb})")
-    fuzz = _run_worker(flavor, runtime, "fuzz", timeout=570)
-    out = fuzz.stdout + fuzz.stderr
-    assert fuzz.returncode == 0, \
-        f"{flavor} fuzz leg exited {fuzz.returncode}:\n{out[-2000:]}"
+    run = _run_worker(flavor, runtime, mode, timeout=570)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, \
+        f"{flavor} {mode} leg exited {run.returncode}:\n{out[-2000:]}"
     assert report_marker not in out, \
-        f"sanitizer report in {flavor} fuzz leg:\n{out[-2000:]}"
-    assert "fuzz ok" in fuzz.stdout
+        f"sanitizer report in {flavor} {mode} leg:\n{out[-2000:]}"
+    assert f"{mode} ok" in run.stdout
 
 
 @pytest.mark.slow
@@ -98,3 +98,14 @@ def test_asan_serde_fuzz_leg():
     """Same matrix under AddressSanitizer+UBSan — truncated/bit-flipped
     frames and the decode-plan validation are the overflow surface."""
     _leg("asan", "ERROR: AddressSanitizer")
+
+
+@pytest.mark.slow
+def test_tsan_thread_planes_leg():
+    """The long-lived Python thread planes under ThreadSanitizer: the
+    tiered store's writer/prefetcher against concurrent
+    put/fetch/prefetch/evict (wanted-flag races, spill I/O through the
+    instrumented native file path), StallWatchdog arm/disarm against its
+    timer thread, HeartbeatEmitter start/stop against foreground
+    beats."""
+    _leg("tsan", "WARNING: ThreadSanitizer", mode="planes")
