@@ -43,6 +43,13 @@ of the faithful+optimal pair).
 windows; ``{process}`` placeholder supported) to PATH, so a bench run
 leaves the same telemetry a production run would — inspect it with
 ``scripts/shuffle_report.py`` / ``shuffle_top.py`` / ``shuffle_trace.py``.
+
+On TPU three extra legs run after the width pair: the fused remote-DMA
+ring transport, the out-of-core tiered-store oversubscription run, and
+the multi-tenant service split (two concurrent TeraSort tenants through
+one ShuffleService; aggregate GB/s/chip plus a min/max per-tenant
+fairness ratio). Off-TPU each reports ``null`` with a labeled
+``*_skipped`` reason instead of a meaningless CPU number.
 """
 
 import argparse
@@ -200,6 +207,79 @@ def run_oversub(record_words: int, records_per_device: int,
             manager.stop()
 
 
+def run_multitenant(record_words: int, records_per_device: int,
+                    repeats: int, journal: str = ""):
+    """Multi-tenant leg: two concurrent TeraSort tenants through ONE
+    :class:`ShuffleService` (shared mesh, slot pool, journal; per-tenant
+    quotas and admission at defaults = uncapped). Returns
+    ``(aggregate_gbps_per_chip, stats)`` where the aggregate sums both
+    tenants' steady-state throughput and ``fairness`` is min/max of the
+    per-tenant rates — 1.0 means the deficit-round-robin admission and
+    the shared pool served both tenants evenly."""
+    import threading
+
+    import jax
+
+    from sparkrdma_tpu import ShuffleConf
+    from sparkrdma_tpu.service import ShuffleService
+    from sparkrdma_tpu.workloads.terasort import run_terasort
+
+    mesh_size = len(jax.devices())
+    rpd = records_per_device // 2     # the tenants share the HBM budget
+    slot = max(4096, rpd)
+    kw = {"metrics_sink": journal} if journal else {}
+    conf = ShuffleConf(slot_records=slot,
+                       max_rounds=64,
+                       max_slot_records=max(1 << 22, 2 * slot),
+                       val_words=record_words - 2,
+                       geometry_classes="fine",
+                       pack_sort_min_payload=0,
+                       wide_sort_min_payload=0, **kw)
+    results: dict = {}
+    errors: list = []
+
+    def tenant_run(svc, name, sid, seed):
+        m = svc.open_session(name)
+        try:
+            res, _, _ = run_terasort(m, records_per_device=rpd,
+                                     seed=seed, verify=False,
+                                     device_verify=True, warmup=True,
+                                     repeats=repeats, shuffle_id=sid)
+            results[name] = res
+        except Exception as e:
+            errors.append(f"{name}: {e!r}")
+        finally:
+            svc.close_session(m)
+
+    t0 = time.perf_counter()
+    with ShuffleService(conf=conf) as svc:
+        threads = [
+            threading.Thread(target=tenant_run,
+                             args=(svc, "tenant_a", 20, 11)),
+            threading.Thread(target=tenant_run,
+                             args=(svc, "tenant_b", 21, 12)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    e2e = time.perf_counter() - t0
+    if errors or len(results) < 2:
+        return -1.0, {"errors": errors}
+    if not all(r.verified for r in results.values()):
+        return -1.0, {"errors": ["device verification FAILED"]}
+    rates = {name: r.gbps for name, r in results.items()}
+    aggregate = sum(rates.values())
+    stats = {
+        "per_tenant_gbps": {k: round(v, 3) for k, v in sorted(
+            rates.items())},
+        "fairness": round(min(rates.values()) / max(rates.values()), 3)
+        if max(rates.values()) > 0 else 0.0,
+        "e2e_seconds": round(e2e, 3),
+    }
+    return aggregate / mesh_size, stats
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="TeraSort shuffle throughput bench (one JSON line)")
@@ -312,6 +392,24 @@ def main(argv=None) -> int:
     else:
         out["terasort_oversub_gbps_per_chip"] = None
         out["oversub_skipped"] = oversub_skip
+    # multi-tenant leg (round 11): two concurrent TeraSort tenants
+    # through one ShuffleService. TPU-only — on the CPU test mesh the
+    # split measures thread scheduling, nothing real.
+    if jax.default_backend() == "tpu":
+        mt, mt_stats = run_multitenant(25, records_per_device, repeats,
+                                       journal=args.journal)
+        if mt < 0:
+            print(json.dumps({"error": "multitenant leg FAILED",
+                              "detail": mt_stats}))
+            return 1
+        out["multitenant_gbps_per_chip"] = round(mt, 3)
+        out["multitenant_metrics"] = mt_stats
+    else:
+        out["multitenant_gbps_per_chip"] = None
+        out["multitenant_skipped"] = (
+            f"backend is {jax.default_backend()!r}, not tpu — two "
+            "tenants on a CPU mesh measure thread scheduling, not "
+            "shared-HBM fairness")
     print(json.dumps(out))
     return 0
 

@@ -26,11 +26,20 @@ The schedule is *randomized* per ``--seed`` (clause order, delay
 magnitude, data) but fully deterministic given the seed — a failing
 seed replays exactly.
 
+3. **Two-tenant blast radius** — one :class:`ShuffleService`, a
+   *noisy* tenant whose session conf carries a fault schedule and a
+   *clean* tenant with none, shuffling concurrently. The clean
+   tenant's output must be bit-identical to a solo control run through
+   its own service, and its journal spans must show zero retries, zero
+   injected-fault events and no degradations — the noisy tenant's
+   chaos stays inside its own session plane.
+
 Usage (CPU host, 8 simulated devices)::
 
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py --seed 7
 
-Exit 0: all legs bit-identical, >= 6 sites hit, books balanced.
+Exit 0: all legs bit-identical, >= 6 sites hit, books balanced, and
+the two-tenant leg's clean tenant untouched by the noisy one's faults.
 Prints one JSON summary line (plus per-leg progress on stderr).
 """
 
@@ -148,6 +157,129 @@ def run_legs(m, seed: int, records_per_device: int) -> dict:
     return out
 
 
+def run_service_tenant_leg(svc, tenant, conf, seed, records_per_device,
+                           shuffle_id):
+    """One tenant's repartition through a shared ShuffleService.
+
+    Returns ``(output, sites_hit)`` where output is the host-side
+    (rows, totals) pair — deterministic given (seed, mesh geometry), so
+    comparable bit-for-bit across service instances — and sites_hit is
+    the session fault plane's hit set (empty for a clean tenant).
+    """
+    import jax
+    import numpy as np
+
+    from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+
+    m = svc.open_session(tenant, conf)
+    try:
+        rt = m.runtime
+        mesh = rt.num_partitions
+        rng = np.random.default_rng(seed)
+        x = rng.integers(0, 2**32,
+                         size=(mesh * records_per_device,
+                               m.conf.record_words),
+                         dtype=np.uint32)
+        part = hash_partitioner(mesh, m.conf.key_words)
+        h = m.register_shuffle(shuffle_id, mesh, part)
+        try:
+            m.get_writer(h).write(rt.shard_records(x)).stop(True)
+            rows, totals = m.get_reader(h).read()
+            out = (np.asarray(jax.device_get(rows)).copy(),
+                   np.asarray(jax.device_get(totals)).copy())
+        finally:
+            m.unregister_shuffle(shuffle_id)
+        return out, sorted(m.faults.sites_hit())
+    finally:
+        svc.close_session(m)
+
+
+def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
+    """The blast-radius pass: noisy + clean tenants through one service.
+
+    The noisy tenant's faults are all transient and live entirely in
+    its session conf; the clean tenant runs the identical workload it
+    ran through a solo control service. Verdict fields:
+
+    - ``clean_identical``: clean output == solo-control output, bitwise
+    - ``clean_retries`` / ``clean_fault_events`` / ``clean_degraded``:
+      summed over the clean tenant's journal spans — all must be zero
+    - ``noisy_sites_hit``: the noisy plane must have actually fired
+    """
+    import threading
+
+    from sparkrdma_tpu import ShuffleConf
+    from sparkrdma_tpu.service import ShuffleService
+
+    noisy_spec = ("exchange.dispatch:fail@attempt<2;"
+                  "exchange.stream_round:fail@attempt<1;"
+                  "pool.acquire:delay=1ms@attempt<4")
+    rpd = max(args.records_per_device // 2, 256)
+
+    # --- solo control: the clean tenant alone through its own service --
+    conf_solo = ShuffleConf(spill_dir=os.path.join(tmp, "svc_solo"),
+                            **common)
+    with ShuffleService(conf=conf_solo) as svc:
+        control, _ = run_service_tenant_leg(
+            svc, "clean", None, args.seed + 10, rpd, shuffle_id=12)
+
+    # --- shared service: both tenants concurrently ---------------------
+    journal = os.path.join(tmp, "svc_journal.jsonl")
+    conf_svc = ShuffleConf(spill_dir=os.path.join(tmp, "svc_duo"),
+                           metrics_sink=journal, **common)
+    conf_noisy = ShuffleConf(spill_dir=os.path.join(tmp, "svc_duo"),
+                             metrics_sink=journal, fault_spec=noisy_spec,
+                             **common)
+    results: dict = {}
+    errors: list = []
+
+    def tenant_run(name, conf, sid, seed):
+        try:
+            results[name] = run_service_tenant_leg(
+                svc, name, conf, seed, rpd, shuffle_id=sid)
+        except Exception as e:   # surfaced in the summary, not lost
+            errors.append(f"{name}: {e!r}")
+
+    with ShuffleService(conf=conf_svc) as svc:
+        threads = [
+            threading.Thread(target=tenant_run,
+                             args=("noisy", conf_noisy, 11,
+                                   args.seed + 20)),
+            threading.Thread(target=tenant_run,
+                             args=("clean", None, 12, args.seed + 10)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    clean_spans = [s for s in read_spans(journal)
+                   if s.get("tenant") == "clean"]
+    clean_retries = sum(int(s.get("retry_count") or 0)
+                        for s in clean_spans)
+    clean_faults = sum(1 for s in clean_spans
+                       for e in (s.get("events") or [])
+                       if e.get("name") == "fault:injected")
+    clean_degraded = sorted({d for s in clean_spans
+                             for d in (s.get("degraded") or [])})
+    clean_out = results.get("clean", (None, None))[0]
+    noisy_sites = results.get("noisy", (None, []))[1]
+    identical = clean_out is not None and outputs_equal(control, clean_out)
+    ok = (not errors and identical and bool(clean_spans)
+          and clean_retries == 0 and clean_faults == 0
+          and not clean_degraded and bool(noisy_sites))
+    return {
+        "ok": ok,
+        "errors": errors,
+        "clean_identical": identical,
+        "clean_spans": len(clean_spans),
+        "clean_retries": clean_retries,
+        "clean_fault_events": clean_faults,
+        "clean_degraded": clean_degraded,
+        "noisy_sites_hit": noisy_sites,
+    }
+
+
 def outputs_equal(a, b) -> bool:
     import numpy as np
 
@@ -247,18 +379,24 @@ def main(argv=None) -> int:
             s["span_id"] for s in spans
             if (s.get("retry_count") or 0) > 0 and not s.get("backoff_ms")]
 
-    injected = plane.injected_counts()
-    hard = plane.injected_total(("fail", "corrupt"))
-    recoveries = faults.recovery_counts()
-    degradations = faults.active_degradations()
-    books = hard == retries + faults.recovery_total() \
-        + faults.degradation_total()
+        injected = plane.injected_counts()
+        hard = plane.injected_total(("fail", "corrupt"))
+        recoveries = faults.recovery_counts()
+        degradations = faults.active_degradations()
+        books = hard == retries + faults.recovery_total() \
+            + faults.degradation_total()
+
+        # --- two-tenant blast-radius pass (fresh accounting) -----------
+        faults.reset_accounting()
+        print("two-tenant pass: noisy + clean through one service...",
+              file=sys.stderr, flush=True)
+        tenant_leg = run_two_tenant_leg(args, common, tmp)
 
     identical = {leg: outputs_equal(control[leg], chaos[leg])
                  for leg in control}
     sites = plane.sites_hit()
     ok = (all(identical.values()) and len(sites) >= 6 and books
-          and not spans_missing_backoff)
+          and not spans_missing_backoff and tenant_leg["ok"])
 
     print(json.dumps({
         "ok": ok,
@@ -274,6 +412,7 @@ def main(argv=None) -> int:
         "backoff_ms_total": round(sum(backoffs), 3),
         "spans_missing_backoff": spans_missing_backoff,
         "bit_identical": identical,
+        "tenant_leg": tenant_leg,
     }, default=str))
     return 0 if ok else 1
 

@@ -20,6 +20,10 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
   are the authoritative totals, and sampled span counts are reported as
   scaled-up *estimates* (each kept span carries ``sample_weight``);
 - heartbeats (``{"kind": "heartbeat"}``): last-seen liveness per host;
+- per-tenant breakdown (schema v7, multi-tenant service journals):
+  spans/records/bytes per tenant, exact rollup totals, admission-wait
+  counts from the fair-queueing ``admission`` lines, and the latest
+  heartbeat's per-tenant tier usage;
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
   stalls, retries) to the ShuffleConf knob that addresses them.
 
@@ -93,7 +97,8 @@ def split_kinds(entries: List[dict]) -> Dict[str, List[dict]]:
     """Bucket journal lines by kind; unknown kinds are dropped (forward
     compat: a v4 journal must not break a v3 report)."""
     out: Dict[str, List[dict]] = {
-        "span": [], "stall": [], "rollup": [], "heartbeat": []}
+        "span": [], "stall": [], "rollup": [], "heartbeat": [],
+        "admission": []}
     for e in entries:
         k = e.get("kind") or "span"
         if k in out:
@@ -393,6 +398,75 @@ def heartbeat_summary(heartbeats: List[dict],
     return {"hosts": hosts}
 
 
+def tenant_breakdown(kinds: Dict[str, List[dict]]) -> dict:
+    """Per-tenant rollout of a multi-tenant service journal (schema v7).
+
+    Spans carry the tenant name, rollup windows carry exact per-tenant
+    read totals, the fair-queueing controller journals ``admission``
+    wait lines, and the daemon heartbeat's usage probe snapshots each
+    tenant's live three-tier footprint. Single-tenant journals (no
+    tenant tags anywhere) produce an empty breakdown and the section is
+    skipped."""
+    tenants: Dict[str, dict] = {}
+
+    def cell(name: str) -> dict:
+        return tenants.setdefault(name, {
+            "spans": 0, "records": 0, "bytes": 0, "exchange_s": 0.0,
+            "rollup_reads": 0, "rollup_records": 0, "rollup_bytes": 0,
+            "admission_waits": 0, "admission_wait_ms": 0.0,
+            "hbm_slots": 0, "host_bytes": 0, "disk_bytes": 0})
+
+    for s in kinds["span"]:
+        name = str(s.get("tenant", "") or "")
+        if not name:
+            continue
+        c = cell(name)
+        c["spans"] += 1
+        c["records"] += int(s.get("records", 0) or 0)
+        c["bytes"] += int(s.get("total_bytes",
+                                s.get("records", 0)
+                                * s.get("record_bytes", 0)) or 0)
+        c["exchange_s"] += float(s.get("exchange_s", 0.0) or 0.0)
+    for rb in kinds["rollup"]:
+        name = str(rb.get("tenant", "") or "")
+        if not name:
+            continue
+        c = cell(name)
+        c["rollup_reads"] += int(rb.get("reads", 0) or 0)
+        c["rollup_records"] += int(rb.get("records", 0) or 0)
+        c["rollup_bytes"] += int(rb.get("bytes", 0) or 0)
+    for ad in kinds.get("admission", []):
+        if ad.get("event") != "wait":
+            continue
+        c = cell(str(ad.get("tenant", "") or "?"))
+        c["admission_waits"] += 1
+        c["admission_wait_ms"] += float(ad.get("wait_ms", 0.0) or 0.0)
+    # the newest heartbeat per process carries the live usage probe;
+    # summing across processes gives the fleet-wide footprint
+    latest: Dict[int, dict] = {}
+    for hb in kinds["heartbeat"]:
+        pi = int(hb.get("process_index", 0) or 0)
+        cur = latest.get(pi)
+        if cur is None or float(hb.get("ts", 0) or 0) >= float(
+                cur.get("ts", 0) or 0):
+            latest[pi] = hb
+    for hb in latest.values():
+        usage = hb.get("tenants")
+        if not isinstance(usage, dict):
+            continue
+        for name, u in usage.items():
+            if not isinstance(u, dict):
+                continue
+            c = cell(str(name))
+            c["hbm_slots"] += int(u.get("hbm", 0) or 0)
+            c["host_bytes"] += int(u.get("host", 0) or 0)
+            c["disk_bytes"] += int(u.get("disk", 0) or 0)
+    return {"tenants": {k: {kk: (round(vv, 6) if isinstance(vv, float)
+                                 else vv)
+                            for kk, vv in tenants[k].items()}
+                        for k in sorted(tenants)}}
+
+
 def host_breakdown(spans: List[dict]) -> dict:
     """Cross-host straggler view: per-host exchange time per shuffle.
 
@@ -690,6 +764,25 @@ def print_heartbeats(hb_rep: dict) -> None:
               f"{h['in_flight']}, pool {h['pool_outstanding']}{rss}")
 
 
+def print_tenants(t_rep: dict) -> None:
+    tenants = t_rep["tenants"]
+    print(f"per-tenant (multi-tenant service, {len(tenants)} tenant(s)):")
+    for name, c in tenants.items():
+        print(f"  {name}: {c['spans']} spans, {c['records']:,} records, "
+              f"{_fmt_bytes(c['bytes'])}, exchange {c['exchange_s']:.4f}s")
+        if c["rollup_reads"]:
+            print(f"    exact (rollups): {c['rollup_reads']:,} reads, "
+                  f"{c['rollup_records']:,} records, "
+                  f"{_fmt_bytes(c['rollup_bytes'])}")
+        if c["admission_waits"]:
+            print(f"    admission: {c['admission_waits']} wait(s), "
+                  f"{c['admission_wait_ms']:,.1f} ms queued")
+        if c["hbm_slots"] or c["host_bytes"] or c["disk_bytes"]:
+            print(f"    live usage: {c['hbm_slots']} HBM slot(s), "
+                  f"host {_fmt_bytes(c['host_bytes'])}, "
+                  f"disk {_fmt_bytes(c['disk_bytes'])}")
+
+
 def print_stalls(stalls: List[dict]) -> None:
     print(f"watchdog stalls: {len(stalls)} report(s)")
     for e in stalls:
@@ -717,13 +810,18 @@ def main(argv=None) -> int:
     stalls: List[dict] = []
     rollups: List[dict] = []
     heartbeats: List[dict] = []
+    admissions: List[dict] = []
     for path in args.journals:
         kinds = split_kinds(load_entries(path))
         spans.extend(kinds["span"])
         stalls.extend(kinds["stall"])
         rollups.extend(kinds["rollup"])
         heartbeats.extend(kinds["heartbeat"])
+        admissions.extend(kinds["admission"])
     rep = aggregate(spans)
+    tenant_rep = tenant_breakdown({
+        "span": spans, "stall": stalls, "rollup": rollups,
+        "heartbeat": heartbeats, "admission": admissions})
     hosts_rep = host_breakdown(spans) if spans else {"hosts": [],
                                                      "per_shuffle": {}}
     roll_rep = aggregate_rollups(rollups)
@@ -734,6 +832,7 @@ def main(argv=None) -> int:
         rep["stall_reports"] = stalls
         rep["rollups"] = roll_rep
         rep["heartbeats"] = hb_rep
+        rep["tenants"] = tenant_rep["tenants"]
         if args.doctor:
             rep["doctor"] = diagnose(spans, stalls)
         json.dump(rep, sys.stdout, indent=2)
@@ -744,6 +843,8 @@ def main(argv=None) -> int:
             print_rollups(roll_rep)
         if hb_rep["hosts"]:
             print_heartbeats(hb_rep)
+        if tenant_rep["tenants"]:
+            print_tenants(tenant_rep)
         if multi_host:
             print_hosts(hosts_rep)
         if stalls:

@@ -8,9 +8,14 @@ two-table view:
 - **hosts**: one row per process — heartbeat age (``STALE`` flag past
   ``--stale`` seconds), in-flight reads, pool outstanding, RSS, reads/s
   and MB/s over the recent rate window, span p95 latency, spills, stalls;
-- **shuffles**: one row per shuffle id — reads (sampling-corrected when
-  the journal was written with ``ShuffleConf.journal_sample``), records,
-  bytes, p95 latency, spills, retries.
+- **shuffles**: one row per (tenant, shuffle id) — reads
+  (sampling-corrected when the journal was written with
+  ``ShuffleConf.journal_sample``), records, bytes, p95 latency, spills,
+  retries;
+- **tenants** (when the journal came from a multi-tenant
+  ``ShuffleService``): per-tenant tier usage from the daemon
+  heartbeat's usage probe plus admission-wait counts from the
+  fair-queueing ``admission`` lines.
 
 Rotated segments (``journal.jsonl.1``, … from
 ``ShuffleConf.journal_max_bytes``) are discovered and merged
@@ -93,9 +98,10 @@ def _expand(patterns: List[str]) -> List[str]:
 
 def collect(paths: List[str]) -> Dict[str, List[dict]]:
     """Bucket every entry of every journal by kind (span/stall/rollup/
-    heartbeat); unknown kinds are dropped (forward compat)."""
+    heartbeat/admission); unknown kinds are dropped (forward compat)."""
     kinds: Dict[str, List[dict]] = {
-        "span": [], "stall": [], "rollup": [], "heartbeat": []}
+        "span": [], "stall": [], "rollup": [], "heartbeat": [],
+        "admission": []}
     for path in paths:
         for entry in load_entries(path):
             kind = entry.get("kind") or "span"
@@ -289,20 +295,25 @@ def build_host_rows(
 
 
 def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
-    """Per-shuffle totals; rollup windows preferred (they see sampled-out
-    spans exactly), raw spans fill in what rollups don't carry."""
-    shuffles: Dict[int, dict] = {}
+    """Per-(tenant, shuffle) totals; rollup windows preferred (they see
+    sampled-out spans exactly), raw spans fill in what rollups don't
+    carry. Single-tenant journals (empty tenant tag) collapse to the
+    old per-shuffle view."""
+    shuffles: Dict[Tuple[str, int], dict] = {}
 
-    def cell(sid: int) -> dict:
-        if sid not in shuffles:
-            shuffles[sid] = {"shuffle_id": sid, "reads": 0, "records": 0,
-                            "bytes": 0, "spills": 0, "retries": 0,
-                            "sync_fetches": 0,
-                            "lat": [], "p95_ms": 0.0, "exact": False}
-        return shuffles[sid]
+    def cell(tenant: str, sid: int) -> dict:
+        k = (tenant, sid)
+        if k not in shuffles:
+            shuffles[k] = {"tenant": tenant, "shuffle_id": sid,
+                           "reads": 0, "records": 0,
+                           "bytes": 0, "spills": 0, "retries": 0,
+                           "sync_fetches": 0,
+                           "lat": [], "p95_ms": 0.0, "exact": False}
+        return shuffles[k]
 
     for rb in kinds["rollup"]:
-        c = cell(int(rb.get("shuffle_id", 0) or 0))
+        c = cell(str(rb.get("tenant", "") or ""),
+                 int(rb.get("shuffle_id", 0) or 0))
         c["exact"] = True
         c["reads"] += int(rb.get("reads", 0) or 0)
         c["records"] += int(rb.get("records", 0) or 0)
@@ -315,7 +326,8 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
         c["p95_ms"] = max(c["p95_ms"], float(rb.get("p95_ms", 0.0) or 0.0))
 
     for s in kinds["span"]:
-        c = cell(int(s.get("shuffle_id", 0) or 0))
+        c = cell(str(s.get("tenant", "") or ""),
+                 int(s.get("shuffle_id", 0) or 0))
         c["lat"].append(span_latency_ms(s))
         if not c["exact"]:  # no rollups in this journal: estimate from spans
             w = int(s.get("sample_weight", 1) or 1)
@@ -329,6 +341,47 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
             c["p95_ms"] = _p95(c["lat"])
         del c["lat"]
     return [shuffles[k] for k in sorted(shuffles)]
+
+
+def build_tenant_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
+    """Per-tenant tier usage + admission-wait totals.
+
+    Usage comes from the newest heartbeat per process (the daemon's
+    per-tenant usage probe), summed across hosts; wait counts come from
+    the fair-queueing controller's journaled ``admission`` lines. Empty
+    when the journal came from a standalone (single-tenant) manager.
+    """
+    latest_hb: Dict[int, dict] = {}
+    for hb in kinds["heartbeat"]:
+        pidx = int(hb.get("process_index", 0) or 0)
+        if pidx not in latest_hb or float(hb.get("ts", 0.0) or 0.0) >= \
+                float(latest_hb[pidx].get("ts", 0.0) or 0.0):
+            latest_hb[pidx] = hb
+    tenants: Dict[str, dict] = {}
+
+    def cell(name: str) -> dict:
+        if name not in tenants:
+            tenants[name] = {"tenant": name, "hbm": 0, "host": 0,
+                             "disk": 0, "waits": 0, "wait_ms": 0.0}
+        return tenants[name]
+
+    for hb in latest_hb.values():
+        usage = hb.get("tenants")
+        if not isinstance(usage, dict):
+            continue
+        for name, u in usage.items():
+            c = cell(str(name))
+            if isinstance(u, dict):
+                c["hbm"] += int(u.get("hbm", 0) or 0)
+                c["host"] += int(u.get("host", 0) or 0)
+                c["disk"] += int(u.get("disk", 0) or 0)
+    for ad in kinds.get("admission", []):
+        if ad.get("event") != "wait":
+            continue
+        c = cell(str(ad.get("tenant", "") or "?"))
+        c["waits"] += 1
+        c["wait_ms"] += float(ad.get("wait_ms", 0.0) or 0.0)
+    return [tenants[k] for k in sorted(tenants)]
 
 
 def render(
@@ -346,7 +399,8 @@ def render(
     lines.append(
         f"shuffle_top — {len(hosts)} host(s), {len(shuffles)} shuffle(s), "
         f"{n_spans} spans{sampled}, {len(kinds['rollup'])} rollup window(s), "
-        f"{len(kinds['stall'])} stall(s)")
+        f"{len(kinds['stall'])} stall(s), "
+        f"{len(kinds.get('admission', []))} admission wait(s)")
     lines.append("")
     lines.append(f"{'HOST':>4}  {'NAME':<14} {'PID':>7} {'HB AGE':>7} "
                  f"{'INFL':>4} {'POOL':>4} {'RSS':>8} {'READS/S':>8} "
@@ -368,16 +422,30 @@ def render(
     if not hosts:
         lines.append("  (no entries yet)")
     lines.append("")
-    lines.append(f"{'SHUFFLE':>7}  {'READS':>8} {'RECORDS':>12} "
+    lines.append(f"{'SHUFFLE':>7}  {'TENANT':<10} {'READS':>8} "
+                 f"{'RECORDS':>12} "
                  f"{'BYTES':>10} {'P95MS':>8} {'SPILL':>5} {'RETRY':>5} "
                  f"{'SYNCF':>5}  SRC")
     for c in shuffles:
         src = "rollup" if c["exact"] else "spans"
+        tenant = c["tenant"] or "-"
         lines.append(
-            f"{c['shuffle_id']:>7}  {c['reads']:>8} {c['records']:>12} "
+            f"{c['shuffle_id']:>7}  {tenant[:10]:<10} {c['reads']:>8} "
+            f"{c['records']:>12} "
             f"{_fmt_bytes(float(c['bytes'])):>10} {c['p95_ms']:>8.1f} "
             f"{c['spills']:>5} {c['retries']:>5} "
             f"{c['sync_fetches']:>5}  {src}")
+    tenants = build_tenant_rows(kinds)
+    if tenants:
+        lines.append("")
+        lines.append(f"{'TENANT':<12} {'HBM':>4} {'HOST':>10} "
+                     f"{'DISK':>10} {'WAITS':>6} {'WAIT MS':>9}")
+        for c in tenants:
+            lines.append(
+                f"{c['tenant'][:12]:<12} {c['hbm']:>4} "
+                f"{_fmt_bytes(float(c['host'])):>10} "
+                f"{_fmt_bytes(float(c['disk'])):>10} "
+                f"{c['waits']:>6} {c['wait_ms']:>9.1f}")
     return "\n".join(lines)
 
 

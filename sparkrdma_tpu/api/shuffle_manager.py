@@ -31,6 +31,7 @@ Differences forced (and earned) by SPMD:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -53,7 +54,8 @@ from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.obs.rollup import HeartbeatEmitter, RollupAggregator, span_latency_ms
-from sparkrdma_tpu.obs.timeline import EventTimeline, set_active
+from sparkrdma_tpu.obs.timeline import (EventTimeline, scoped_active,
+                                        set_active)
 from sparkrdma_tpu.obs.watchdog import StallWatchdog, install_state_dump
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.profiling import annotate, annotate_span
@@ -132,7 +134,8 @@ class ShuffleWriter:
         if not success or self._records is None:
             self._records = None
             return None
-        with Timer() as t, annotate("shuffle:plan"):
+        with self._m._tenant_scope(), Timer() as t, \
+                annotate("shuffle:plan"):
             self._plan = self._m._exchange.plan(
                 self._records, self._h.partitioner, self._h.num_parts
             )
@@ -216,12 +219,28 @@ class ShuffleReader:
         # (and shuffle_top) can tell a host mid-read from an idle one
         self._m._read_started()
         try:
-            return self._read(record_stats)
+            with self._m._tenant_scope():
+                return self._read(record_stats)
         finally:
             self._m._read_finished()
 
     def _read(self, record_stats: bool) -> Tuple[jax.Array, jax.Array]:
         writer = self._m._recover_writer(self._h)
+        adm = self._m.admission
+        if adm is None:
+            return self._read_attempts(writer, record_stats)
+        # Admission control (service mode): one ticket per read(),
+        # weighted by the plan's round count so the deficit-round-robin
+        # scheduler shares exchange ROUNDS, not read calls — a tenant of
+        # 16-round shuffles cannot starve a tenant of 2-round ones. An
+        # over-quota/over-capacity tenant QUEUES here (journaled as an
+        # `admission` wait line) rather than failing.
+        with adm.admit(self._m.tenant,
+                       cost=max(int(writer.plan.num_rounds), 1)):
+            return self._read_attempts(writer, record_stats)
+
+    def _read_attempts(self, writer: ShuffleWriter,
+                       record_stats: bool) -> Tuple[jax.Array, jax.Array]:
         ex = self._m._exchange
         conf = self._m.conf
         # one journal span per read() call (not per attempt — retries are
@@ -381,6 +400,7 @@ class ShuffleReader:
                 span = ExchangeSpan(
                     span_id=span_id,
                     shuffle_id=self._h.shuffle_id,
+                    tenant=self._m.tenant,
                     transport=ex.transport(),
                     rounds=plan.num_rounds,
                     dispatches=ex.last_dispatches,
@@ -551,9 +571,25 @@ class ShuffleManager:
 
     def __init__(self, runtime: Optional[MeshRuntime] = None,
                  conf: Optional[ShuffleConf] = None,
-                 store: Optional[MapOutputStore] = None):
+                 store: Optional[MapOutputStore] = None, *,
+                 tenant: str = "",
+                 tiered: Optional[TieredStore] = None,
+                 journal: Optional[ExchangeJournal] = None,
+                 admission=None,
+                 account=None):
         self.runtime = runtime or MeshRuntime(conf)
         self.conf = conf or self.runtime.conf
+        # Service mode (tiered= provided): this manager is a TENANT
+        # SESSION handed out by a ShuffleService daemon. The runtime,
+        # tiered store and journal are process singletons owned by the
+        # daemon — shared, never closed here — and per-tenant state
+        # (fault plane, timeline) installs thread-locally via
+        # _tenant_scope() instead of into the process-wide slots, so one
+        # tenant's chaos schedule or trace never bleeds into another's.
+        self.tenant = tenant
+        self.account = account
+        self.admission = admission
+        self._service_mode = tiered is not None
         if store is None and self.conf.spill_dir:
             store = MapOutputStore(
                 self.conf.spill_dir,
@@ -567,7 +603,8 @@ class ShuffleManager:
         # refuses when neither spill_tier_dir nor spill_dir is set) — and
         # handed to the exchange so round buffers are acquired through it
         # and eviction/prefetch I/O overlaps the exchange rounds.
-        self.tiered = TieredStore(self.conf, pool=self.runtime.pool)
+        self.tiered = (tiered if tiered is not None
+                       else TieredStore(self.conf, pool=self.runtime.pool))
         # unified observability root: either knob turns the registry on
         # (collect_shuffle_read_stats for in-memory stats, metrics_sink
         # for the journal); off, every instrument is a shared no-op
@@ -577,12 +614,16 @@ class ShuffleManager:
         # multi-host: a shared sink path would interleave hosts' lines;
         # the {process} placeholder gives each host its own journal file
         # (merged later by shuffle_report.py / shuffle_trace.py)
-        sink = self.conf.metrics_sink
-        if isinstance(sink, str) and "{process}" in sink:
-            sink = sink.replace("{process}",
-                                str(self.runtime.process_index))
-        self.journal = ExchangeJournal(sink, metrics=self.metrics,
-                                       max_bytes=self.conf.journal_max_bytes)
+        if journal is not None:
+            self.journal = journal       # daemon-owned, shared, not closed
+        else:
+            sink = self.conf.metrics_sink
+            if isinstance(sink, str) and "{process}" in sink:
+                sink = sink.replace("{process}",
+                                    str(self.runtime.process_index))
+            self.journal = ExchangeJournal(
+                sink, metrics=self.metrics,
+                max_bytes=self.conf.journal_max_bytes)
         # span sampling: which reads get a full journal line (the rest
         # still feed metrics + rollups; see obs.journal.SamplingPolicy)
         self.sampler = self.conf.sampling_policy()
@@ -596,7 +637,10 @@ class ShuffleManager:
         # liveness: reads currently executing (heartbeat + shuffle_top)
         self._reads_in_flight = 0
         self.heartbeat = None
-        if self.journal.enabled and self.conf.heartbeat_s > 0:
+        # service mode: the daemon owns THE heartbeat (with the
+        # per-tenant usage probe); sessions never start their own
+        if (not self._service_mode and self.journal.enabled
+                and self.conf.heartbeat_s > 0):
             pool = self.runtime.pool
             self.heartbeat = HeartbeatEmitter(
                 self.journal, self.conf.heartbeat_s,
@@ -617,7 +661,11 @@ class ShuffleManager:
         # per-span event timeline: events accumulate across plan+read and
         # drain into the span's `events` array at emit time
         self.timeline = EventTimeline(enabled=self.journal.enabled)
-        set_active(self.timeline)
+        if not self._service_mode:
+            # the process-wide timeline slot belongs to the standalone
+            # manager; tenant sessions install theirs thread-locally
+            # inside _tenant_scope() instead
+            set_active(self.timeline)
         self.watchdog = StallWatchdog(self.conf.watchdog_timeout_s,
                                       journal=self.journal,
                                       metrics=self.metrics,
@@ -628,11 +676,21 @@ class ShuffleManager:
         # installed process-wide (module-level sites — staging, serde,
         # checkpoint — reach it without a handle through every signature)
         self.faults = _faults.FaultPlane(self.conf.fault_spec)
-        self._prev_plane = _faults.set_active_plane(
-            self.faults if self.faults.enabled else None)
+        # blast-radius isolation: a tenant session's plane reaches the
+        # module-level fault sites through the thread-local overlay
+        # (faults.scoped_plane) only while that tenant's calls run, so
+        # its schedule/degradations never fire inside another tenant's
+        # shuffle. Standalone managers keep the process-wide install.
+        self._prev_plane = None
+        self._plane_installed = not self._service_mode
+        if self._plane_installed:
+            self._prev_plane = _faults.set_active_plane(
+                self.faults if self.faults.enabled else None)
         # the runtime's SlotPool serves exchange recv/output buffers
         # (RdmaBufferManager wiring: the node owns the pool, channels use it)
-        if self.runtime.pool is not None:
+        if self.runtime.pool is not None and not self._service_mode:
+            # service mode: the pool is a shared singleton already wired
+            # to the daemon's registries — a session must not re-point it
             self.runtime.pool.metrics = self.metrics
             self.runtime.pool.timeline = self.timeline
         self.stats = ShuffleReadStats(self.conf.collect_shuffle_read_stats,
@@ -649,7 +707,9 @@ class ShuffleManager:
                                          identity=(
                                              self.runtime.process_index,
                                              self.runtime.process_count),
-                                         store=self.tiered)
+                                         store=self.tiered,
+                                         tenant=self.tenant,
+                                         account=self.account)
         ids = tuple(self.runtime.manager_id(i)
                     for i in range(self.runtime.num_partitions))
         self._registry = MapOutputRegistry(ids, metrics=self.metrics)
@@ -685,6 +745,12 @@ class ShuffleManager:
         # must have consumed this shuffle's reads by now — the reference
         # frees registered buffers on unregisterShuffle the same way)
         self._exchange.release_shuffle(shuffle_id)
+        # tiered-store teardown: drop this shuffle's remaining segments
+        # (host leases AND disk files). Without this, segments published
+        # via put(..., shuffle=)/adopt() outlived their shuffle until
+        # close() — pinned host bytes and .seg files leaking across the
+        # manager's lifetime.
+        self.tiered.delete_shuffle(shuffle_id, tenant=self.tenant)
         if self.store is not None:  # shuffle files removed on unregister
             self.store.delete(shuffle_id)
 
@@ -826,7 +892,8 @@ class ShuffleManager:
                 continue
             self.tiered.adopt(key,
                               self.store.segment_path(shuffle_id, entry),
-                              entry["shape"], entry["dtype"])
+                              entry["shape"], entry["dtype"],
+                              tenant=self.tenant, shuffle=shuffle_id)
             adopted.append(key)
         log.info("shuffle %d segment resume: %d/%d segments replayed",
                  shuffle_id, len(adopted), len(meta["segments"]))
@@ -847,7 +914,7 @@ class ShuffleManager:
         )
 
     def stop(self) -> None:
-        if _faults.active_plane() is self.faults:
+        if self._plane_installed and _faults.active_plane() is self.faults:
             _faults.set_active_plane(self._prev_plane)
         if self.stats.enabled and self.stats.records:
             self.stats.print_histogram()
@@ -855,6 +922,18 @@ class ShuffleManager:
             self.heartbeat.stop()       # emits one final beat
         if self.rollup is not None:
             self.rollup.flush()         # close the open window
+        # recycled round/output buffers (incl. the donation chain's tail)
+        # go back to the pool before any teardown that might retire it
+        self._exchange.release_all()
+        if self._service_mode:
+            # tenant session teardown: every segment this tenant still
+            # holds in the shared store is dropped (host leases, disk
+            # files, quota charges) — but the daemon's singletons
+            # (journal, tiered store, runtime, pool) stay up for the
+            # other tenants.
+            self.tiered.delete_tenant(self.tenant)
+            self._writers.clear()
+            return
         self.journal.close()
         self.tiered.close()
         self._writers.clear()
@@ -867,6 +946,26 @@ class ShuffleManager:
     def _read_finished(self) -> None:
         self._reads_in_flight -= 1
         self.metrics.gauge("reads.in_flight").set(self._reads_in_flight)
+
+    def _tenant_scope(self) -> contextlib.ExitStack:
+        """Thread-local tenant overlay for the duration of one SPI call.
+
+        In service mode this installs the session's fault plane and
+        event timeline into the CALLING THREAD only
+        (``faults.scoped_plane`` / ``timeline.scoped_active``), so
+        module-level fault sites and ``record_active`` reach tenant-
+        scoped state without a handle — and, critically, WITHOUT the
+        process-wide install a standalone manager uses, which would let
+        one tenant's chaos schedule fire inside a concurrent tenant's
+        shuffle. Standalone managers return an empty stack (the globals
+        are already theirs).
+        """
+        stack = contextlib.ExitStack()
+        if self._service_mode:
+            stack.enter_context(_faults.scoped_plane(
+                self.faults if self.faults.enabled else None))
+            stack.enter_context(scoped_active(self.timeline))
+        return stack
 
     # --- helpers ------------------------------------------------------
     def _filtered(self, out: jax.Array, totals: jax.Array,

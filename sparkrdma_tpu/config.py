@@ -302,6 +302,38 @@ class ShuffleConf:
     #: failure is counted as a ``spill_reread`` recovery).
     spill_tier_reread_attempts: int = 3
 
+    # --- multi-tenant service (sparkrdma_tpu/service/) ---
+    #: default per-tenant HBM quota, in slot-pool buffers concurrently
+    #: held (service/tenant.py; enforced inside SlotPool acquisition).
+    #: 0 (default) = unlimited. A tenant at its quota BLOCKS in
+    #: acquisition until one of its buffers is returned (bounded by
+    #: ``admission_wait_s``), it never steals from other tenants.
+    tenant_hbm_slots: int = 0
+    #: default per-tenant pinned-host-tier quota in bytes (TieredStore
+    #: host tier). 0 (default) = unlimited. Over-quota puts block until
+    #: the tenant's own segments evict to disk or are dropped.
+    tenant_host_bytes: int = 0
+    #: default per-tenant disk-tier quota in bytes (TieredStore disk
+    #: segments). 0 (default) = unlimited. Eviction refuses to demote a
+    #: tenant already at its disk quota (its hot set stays host-side and
+    #: the tenant's puts block instead).
+    tenant_disk_bytes: int = 0
+    #: exchange reads admitted concurrently across ALL tenants by the
+    #: service's deficit-round-robin admission controller
+    #: (service/admission.py). 0 (default) = unlimited (admission
+    #: bookkeeping still journals per-tenant waits).
+    admission_slots: int = 0
+    #: deficit-round-robin refill quantum, in exchange ROUNDS per sweep:
+    #: each pass over the tenant ring adds this many rounds to a waiting
+    #: tenant's deficit; a read is admitted once its tenant's deficit
+    #: covers the read's planned round count. Larger values favor big
+    #: reads (less interleaving), smaller values favor fairness.
+    admission_quantum: float = 1.0
+    #: upper bound on any single quota/admission wait in seconds; a
+    #: tenant still over quota (or unadmitted) after this long fails
+    #: its operation with a clear error instead of waiting forever.
+    admission_wait_s: float = 300.0
+
     # --- byte-payload serde (api/serde.py, api/pipeline.py) ---
     #: dispatch encode/decode to the multi-threaded C++ codec in
     #: native/staging.cpp when it is available (built on demand, GIL
@@ -376,6 +408,24 @@ class ShuffleConf:
         if self.spill_tier_reread_attempts <= 0:
             raise ValueError("spill_tier_reread_attempts must be >= 1 "
                              "(1 = no re-reads)")
+        if self.tenant_hbm_slots < 0:
+            raise ValueError("tenant_hbm_slots must be >= 0 (0 = "
+                             "unlimited)")
+        if self.tenant_host_bytes < 0:
+            raise ValueError("tenant_host_bytes must be >= 0 (0 = "
+                             "unlimited)")
+        if self.tenant_disk_bytes < 0:
+            raise ValueError("tenant_disk_bytes must be >= 0 (0 = "
+                             "unlimited)")
+        if self.admission_slots < 0:
+            raise ValueError("admission_slots must be >= 0 (0 = "
+                             "unlimited)")
+        if self.admission_quantum <= 0:
+            raise ValueError("admission_quantum must be > 0 (rounds "
+                             "refilled per DRR sweep)")
+        if self.admission_wait_s < 0:
+            raise ValueError("admission_wait_s must be >= 0 (0 = fail "
+                             "immediately when over quota)")
         if self.serde_threads < 0:
             raise ValueError("serde_threads must be >= 0 (0 = auto)")
         if self.serde_chunk_records < 0:

@@ -197,11 +197,18 @@ class ShuffleExchange:
                  journal=None,
                  rollup=None,
                  identity: Tuple[int, int] = (0, 1),
-                 store=None):
+                 store=None,
+                 tenant: str = "",
+                 account=None):
         self.mesh = mesh
         self.axis_name = axis_name
         self.conf = conf or ShuffleConf()
         self.mesh_size = int(mesh.shape[axis_name])
+        # multi-tenant service identity: spans carry it, exec-cache and
+        # collective-id keys fold it in (two tenants' identically-shaped
+        # exchanges must not alias), and the account meters HBM buffers
+        self.tenant = tenant
+        self.account = account
         # tiered out-of-core store (hbm/tiered_store.py): when present,
         # round buffers are acquired/released through it so its
         # per-acquisition service() poke overlaps host->disk eviction
@@ -282,14 +289,16 @@ class ShuffleExchange:
         the round), straight from the pool otherwise. Caller guarantees
         ``self.pool is not None``."""
         if self.store is not None:
-            return self.store.acquire_device(shape, jnp.uint32, sharding)
-        return self.pool.get_shaped(shape, jnp.uint32, sharding)
+            return self.store.acquire_device(shape, jnp.uint32, sharding,
+                                             account=self.account)
+        return self.pool.get_shaped(shape, jnp.uint32, sharding,
+                                    account=self.account)
 
     def _put_buf(self, arr, sharding) -> None:
         if self.store is not None:
-            self.store.release_device(arr, sharding)
+            self.store.release_device(arr, sharding, account=self.account)
         else:
-            self.pool.put_shaped(arr, sharding)
+            self.pool.put_shaped(arr, sharding, account=self.account)
 
     def _degrade_transport(self, exc: BaseException) -> None:
         if not self.conf.transport_fallback:
@@ -980,7 +989,9 @@ class ShuffleExchange:
 
         prep = cached(("prep", num_parts, w, pkey),
                       lambda: self._build_prep(num_parts, w, partitioner))
-        chunk_key = ("chunk", num_parts, cap, F, w)
+        # tenant folded in: two tenants' identically-shaped streaming
+        # exchanges must derive distinct collective ids (and programs)
+        chunk_key = ("chunk", self.tenant, num_parts, cap, F, w)
         chunk_fn = cached(chunk_key,
                           lambda: self._build_chunk(
                               num_parts, cap, F, w,
@@ -1162,7 +1173,10 @@ class ShuffleExchange:
         per_dev = np.array([owned[d::self.mesh_size].sum()
                             for d in range(self.mesh_size)])
         tight = bool((per_dev == plan.out_capacity).all())
-        key = (num_parts, plan.capacity, plan.num_rounds, plan.out_capacity,
+        # tenant folded in so two tenants' same-geometry fused programs
+        # (and their derived collective ids) never alias
+        key = (self.tenant, num_parts, plan.capacity, plan.num_rounds,
+               plan.out_capacity,
                w, sort_key_words, aggregator, float_payload, tight,
                getattr(partitioner, "cache_key", id(partitioner)))
         donate = self.pool is not None
@@ -1216,6 +1230,17 @@ class ShuffleExchange:
             return
         for okey in [k for k in self._out_prev if k[0] == shuffle_id]:
             arr, sharding = self._out_prev.pop(okey)
+            self._put_buf(arr, sharding)
+
+    def release_all(self) -> None:
+        """Return every recycled output buffer (session teardown — the
+        per-tenant exchange dies with its session, so nothing may stay
+        charged to the tenant's account)."""
+        if self.pool is None:
+            self._out_prev.clear()
+            return
+        while self._out_prev:
+            _, (arr, sharding) = self._out_prev.popitem()
             self._put_buf(arr, sharding)
 
     def shuffle(
@@ -1286,6 +1311,7 @@ class ShuffleExchange:
                 store_fetch_bytes=st_fetch,
                 store_prefetch_hits=st_hits,
                 store_sync_fetches=st_sync,
+                tenant=self.tenant,
             )
             weight = self.sampler.keep_weight(span_id, t.elapsed)
             if self.rollup is not None:

@@ -56,6 +56,7 @@ The legacy single-site knobs (``ShuffleConf.fault_injection_rate`` and
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import re
 import threading
@@ -201,6 +202,10 @@ class FaultPlane:
             self._by_site.setdefault(r.site, []).append(r)
         self._hits: Dict[str, int] = {}
         self._injected: Dict[str, Dict[str, int]] = {}
+        # per-plane degradation/recovery tallies — the accounting a
+        # thread-scoped (tenant) plane sees instead of the process books
+        self._degr: Dict[str, int] = {}
+        self._recov: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def check(self, site: str) -> Optional[str]:
@@ -255,6 +260,10 @@ NULL_PLANE = FaultPlane("")
 
 _active: FaultPlane = NULL_PLANE
 _active_lock = threading.Lock()
+#: thread-local overlay — a tenant session's plane, installed around its
+#: SPI calls so one tenant's fault schedule (and its degradation books)
+#: never leak into threads serving other tenants
+_tls = threading.local()
 
 
 def set_active_plane(plane: Optional[FaultPlane]) -> FaultPlane:
@@ -265,8 +274,27 @@ def set_active_plane(plane: Optional[FaultPlane]) -> FaultPlane:
     return prev
 
 
+@contextlib.contextmanager
+def scoped_plane(plane: Optional[FaultPlane]):
+    """Install ``plane`` for the CURRENT THREAD only (restores the prior
+    thread scope on exit). While scoped, ``fire`` consults this plane
+    instead of the process-wide one and degradation/recovery accounting
+    lands in the plane's own tallies — the blast-radius boundary for a
+    multi-tenant service. ``scoped_plane(None)`` is a pass-through."""
+    if plane is None:
+        yield
+        return
+    prev = getattr(_tls, "plane", None)
+    _tls.plane = plane
+    try:
+        yield
+    finally:
+        _tls.plane = prev
+
+
 def active_plane() -> FaultPlane:
-    return _active
+    p = getattr(_tls, "plane", None)
+    return p if p is not None else _active
 
 
 def fire(site: str) -> Optional[str]:
@@ -274,9 +302,13 @@ def fire(site: str) -> Optional[str]:
 
     Returns ``None`` (proceed — possibly after an injected delay),
     ``"fail"`` (raise your contract error) or ``"corrupt"`` (mangle the
-    payload). The fast path on an inactive plane is one attribute load.
+    payload). A thread-scoped plane (tenant session) takes precedence
+    over the process-wide one. The fast path on an inactive plane is
+    one attribute load plus a thread-local probe.
     """
-    p = _active
+    p = getattr(_tls, "plane", None)
+    if p is None:
+        p = _active
     if not p.enabled:
         return None
     return p.check(site)
@@ -303,7 +335,14 @@ def note_degradation(name: str, reason: str = "") -> None:
     """Record a sticky graceful degradation (e.g. ``serde_native`` →
     numpy, ``transport`` → xla). Counted once per occurrence; the set of
     ever-degraded names lands in each journal span's ``degraded`` field.
-    """
+    Under a thread-scoped (tenant) plane the tally lands in THAT plane's
+    books — a faulty tenant's degradations never appear in a clean
+    tenant's spans — while the process-wide books still tick for the
+    soak scripts' global accounting loop."""
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            p._degr[name] = p._degr.get(name, 0) + 1
     with _acct_lock:
         _degradations[name] = _degradations.get(name, 0) + 1
     from sparkrdma_tpu.obs.metrics import global_registry
@@ -315,6 +354,10 @@ def note_degradation(name: str, reason: str = "") -> None:
 def note_recovery(name: str) -> None:
     """Record a successful in-place recovery (re-read after a CRC
     mismatch, re-write after a spill failure, checkpoint resume, ...)."""
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            p._recov[name] = p._recov.get(name, 0) + 1
     with _acct_lock:
         _recoveries[name] = _recoveries.get(name, 0) + 1
     from sparkrdma_tpu.obs.metrics import global_registry
@@ -324,22 +367,40 @@ def note_recovery(name: str) -> None:
 
 
 def active_degradations() -> List[str]:
-    """Sorted names of every degradation taken so far in this process."""
+    """Sorted names of every degradation taken so far — in the CURRENT
+    SCOPE: a thread-scoped (tenant) plane reports only its own books,
+    otherwise the process-wide tally."""
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            return sorted(p._degr)
     with _acct_lock:
         return sorted(_degradations)
 
 
 def degradation_total() -> int:
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            return sum(p._degr.values())
     with _acct_lock:
         return sum(_degradations.values())
 
 
 def recovery_total() -> int:
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            return sum(p._recov.values())
     with _acct_lock:
         return sum(_recoveries.values())
 
 
 def recovery_counts() -> Dict[str, int]:
+    p = getattr(_tls, "plane", None)
+    if p is not None:
+        with p._lock:
+            return dict(p._recov)
     with _acct_lock:
         return dict(_recoveries)
 
@@ -370,7 +431,8 @@ def backoff_ms(attempt: int, base_ms: float, span_id: int = 0,
 
 
 __all__ = ["SITES", "CORRUPTIBLE", "FaultRule", "FaultPlane", "NULL_PLANE",
-           "parse_fault_spec", "set_active_plane", "active_plane", "fire",
+           "parse_fault_spec", "set_active_plane", "scoped_plane",
+           "active_plane", "fire",
            "mangle", "note_degradation", "note_recovery",
            "active_degradations", "degradation_total", "recovery_total",
            "recovery_counts", "reset_accounting", "backoff_ms"]

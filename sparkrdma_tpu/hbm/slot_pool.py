@@ -63,16 +63,19 @@ class Slot:
     as the reference tracks block lengths outside the buffer.
     """
 
-    __slots__ = ("array", "capacity", "record_words", "_refs", "_pool", "_lock")
+    __slots__ = ("array", "capacity", "record_words", "_refs", "_pool",
+                 "_lock", "_account")
 
     def __init__(self, array: jax.Array, capacity: int, record_words: int,
-                 pool: "SlotPool"):
+                 pool: "SlotPool", account=None):
         self.array = array
         self.capacity = capacity
         self.record_words = record_words
         self._refs = 1
         self._pool = pool
         self._lock = threading.Lock()
+        # tenant account charged one HBM slot for this buffer's lifetime
+        self._account = account
 
     def retain(self) -> "Slot":
         with self._lock:
@@ -167,8 +170,15 @@ class SlotPool:
             arr = jax.device_put(arr, self.device)
         return arr
 
-    def get(self, n_records: int, record_words: Optional[int] = None) -> Slot:
-        """Pop (or allocate) a slot with capacity >= n_records."""
+    def get(self, n_records: int, record_words: Optional[int] = None,
+            account=None) -> Slot:
+        """Pop (or allocate) a slot with capacity >= n_records.
+
+        ``account`` (a tenant account) is charged one HBM slot for the
+        buffer's lifetime — BLOCKING while the tenant is at its
+        ``hbm_slots`` quota, released when the slot's last reference
+        drops. Charged before the fault site / stack pop so a quota
+        wait never holds pool state."""
         rw = record_words if record_words is not None else self.conf.record_words
         if n_records > self.conf.max_slot_records:
             # maxBufferAllocationSize analogue: refuse absurd requests early.
@@ -184,7 +194,15 @@ class SlotPool:
                 f"max_slot_records {self.conf.max_slot_records}"
             )
         t0 = time.perf_counter()
-        _fire_pool_acquire()
+        if account is not None:
+            account.charge("hbm", 1)
+        try:
+            _fire_pool_acquire()
+        except BaseException:
+            # injected acquire fault: no buffer was handed out
+            if account is not None:
+                account.release("hbm", 1)
+            raise
         arr = None
         with self._lock:
             stack = self._free.get((cls, rw))
@@ -208,9 +226,11 @@ class SlotPool:
         self.timeline.event("pool:acquire", hit=hit,
                             wait_s=round(time.perf_counter() - t0, 6))
         self._track_out()
-        return Slot(arr, cls, rw, self)
+        return Slot(arr, cls, rw, self, account=account)
 
     def _put(self, slot: Slot) -> None:
+        if slot._account is not None:
+            slot._account.release("hbm", 1)
         self._track_in()
         # A slot whose array was donated into a jitted step is dead; returning
         # it would hand a deleted buffer to the next get().
@@ -225,7 +245,7 @@ class SlotPool:
     # shaped buffers — the data path's recv-slot / output-buffer service
     # ------------------------------------------------------------------
     def get_shaped(self, shape: Tuple[int, ...], dtype=jnp.uint32,
-                   sharding=None) -> jax.Array:
+                   sharding=None, account=None) -> jax.Array:
         """Pop (or allocate) a device buffer of an exact shape/sharding.
 
         This is the entry the exchange data path uses: recv-slot chunks
@@ -235,10 +255,23 @@ class SlotPool:
         back with :meth:`put_shaped` when the consumer is done. Exact
         shapes (not size classes) because the compiled-program cache
         already bounds the number of distinct geometries.
+
+        ``account`` is charged one HBM slot (blocking at quota); the
+        caller must pass the SAME account to :meth:`put_shaped` — the
+        accounting is count-based because donation invalidates any
+        identity-keyed tracking of the array itself.
         """
         key = ("shaped", tuple(shape), jnp.dtype(dtype).name, sharding)
         t0 = time.perf_counter()
-        _fire_pool_acquire()
+        if account is not None:
+            account.charge("hbm", 1)
+        try:
+            _fire_pool_acquire()
+        except BaseException:
+            # injected acquire fault: no buffer was handed out
+            if account is not None:
+                account.release("hbm", 1)
+            raise
         arr = None
         with self._lock:
             stack = self._free.get(key)
@@ -274,13 +307,15 @@ class SlotPool:
         self._track_out()
         return arr
 
-    def put_shaped(self, arr: jax.Array, sharding=None) -> None:
+    def put_shaped(self, arr: jax.Array, sharding=None, account=None) -> None:
         """Return a shaped buffer for reuse (no-op if donated/deleted).
 
         Safe to call while enqueued computations still read ``arr``: a
         later ``get_shaped`` that donates it into a new program is
         sequenced after those reads by the runtime's dataflow order.
         """
+        if account is not None:
+            account.release("hbm", 1)
         self._track_in()
         if arr.is_deleted():
             with self._lock:

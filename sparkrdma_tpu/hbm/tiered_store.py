@@ -74,9 +74,10 @@ class _Segment:
 
     __slots__ = ("key", "shape", "dtype", "nbytes", "tier", "pinned",
                  "tick", "lease", "path", "promoted", "wanted", "event",
-                 "error")
+                 "error", "tenant", "shuffle")
 
-    def __init__(self, key: str, shape, dtype, nbytes: int):
+    def __init__(self, key: str, shape, dtype, nbytes: int,
+                 tenant: str = "", shuffle: Optional[int] = None):
         self.key = key
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
@@ -93,6 +94,11 @@ class _Segment:
         self.wanted = False
         self.event: Optional[threading.Event] = None
         self.error: Optional[OSError] = None
+        #: owning tenant ("" = untenanted single-job use) and shuffle id
+        #: (None = not shuffle-scoped) — the quota-accounting and
+        #: teardown dimensions of the multi-tenant service
+        self.tenant = tenant
+        self.shuffle = shuffle
 
 
 class TieredStore:
@@ -112,6 +118,8 @@ class TieredStore:
             use_native=conf.use_native_staging)
         self._own_host_pool = host_pool is None
         self._segments: Dict[str, _Segment] = {}
+        #: tenant name -> TenantAccount (service wiring; guarded by _lock)
+        self._accounts: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._tick = 0                       # guarded-by: _lock
         self._host_bytes = 0                 # guarded-by: _lock
@@ -134,16 +142,26 @@ class TieredStore:
     # HBM tier: thin delegates so the exchange acquires round buffers
     # "through the store" without changing the donated-slot discipline
     # ------------------------------------------------------------------
-    def acquire_device(self, shape, dtype, sharding=None):
+    def acquire_device(self, shape, dtype, sharding=None, account=None):
         """A device round buffer from the HBM tier (SlotPool delegate).
 
         Each acquisition also pokes the background writer — the natural
         per-round hook that lets eviction overlap the exchange."""
         self.service()
-        return self.pool.get_shaped(shape, dtype, sharding)
+        return self.pool.get_shaped(shape, dtype, sharding, account=account)
 
-    def release_device(self, arr, sharding=None) -> None:
-        self.pool.put_shaped(arr, sharding)
+    def release_device(self, arr, sharding=None, account=None) -> None:
+        self.pool.put_shaped(arr, sharding, account=account)
+
+    def register_account(self, tenant: str, account) -> None:
+        """Attach a :class:`~sparkrdma_tpu.service.tenant.TenantAccount`
+        so ``tenant``'s host/disk holdings are charged against its quota.
+        Segments put/adopted with an unregistered tenant name are tagged
+        but unmetered (accounting degrades to plain tagging)."""
+        if not tenant:
+            raise ValueError("tenant name must be non-empty")
+        with self._lock:
+            self._accounts[tenant] = account
 
     def service(self) -> None:
         """Non-blocking poke: wake the writer if host occupancy is over
@@ -157,30 +175,51 @@ class TieredStore:
     # ------------------------------------------------------------------
     # host tier
     # ------------------------------------------------------------------
-    def put(self, key: str, arr: np.ndarray, pin: bool = False) -> None:
+    def put(self, key: str, arr: np.ndarray, pin: bool = False,
+            tenant: str = "", shuffle: Optional[int] = None) -> None:
         """Stage ``arr`` (copied into a pooled host lease) under ``key``.
 
         Watermark enforcement is asynchronous: the put always lands in
         the host tier (so the producer never blocks on disk), then the
-        background writer evicts LRU segments until back under."""
+        background writer evicts LRU segments until back under. A
+        ``tenant`` with a registered account is charged host bytes FIRST
+        (blocking while over quota — each wait slice pokes the writer to
+        demote one of that tenant's own LRU segments, so the wait
+        resolves without touching other tenants)."""
         arr = np.ascontiguousarray(arr)
-        seg = _Segment(key, arr.shape, arr.dtype, arr.nbytes)
+        acct = None
+        if tenant:
+            with self._lock:
+                acct = self._accounts.get(tenant)
+        if acct is not None:
+            # blocking quota admission: entered with NO store lock held
+            acct.charge("host", arr.nbytes,
+                        poke=lambda: self._wq.put(("tenant", tenant)))
+        seg = _Segment(key, arr.shape, arr.dtype, arr.nbytes,
+                       tenant=tenant, shuffle=shuffle)
         lease = self.host_pool.get(arr.nbytes)
         lease.view(arr.dtype, arr.shape)[...] = arr
         seg.lease = lease
-        old_ev, defer_old = None, False
+        old = None
+        old_ev, defer_old, closed = None, False, False
         with self._lock:
             if self._closed:
-                raise RuntimeError("TieredStore is closed")
-            old = self._segments.pop(key, None)
-            if old is not None:
-                old_ev, defer_old = self._drop_locked(old)
-            self._tick += 1
-            seg.tick = self._tick
-            seg.pinned = pin
-            self._segments[key] = seg
-            self._host_bytes += seg.nbytes
-            over = self._host_bytes > self._watermark
+                closed = True
+            else:
+                old = self._segments.pop(key, None)
+                if old is not None:
+                    old_ev, defer_old = self._drop_locked(old)
+                self._tick += 1
+                seg.tick = self._tick
+                seg.pinned = pin
+                self._segments[key] = seg
+                self._host_bytes += seg.nbytes
+                over = self._host_bytes > self._watermark
+        if closed:
+            lease.release()
+            if acct is not None:
+                acct.release("host", arr.nbytes)
+            raise RuntimeError("TieredStore is closed")
         if old_ev is not None:
             old_ev.set()
         if old is not None and not defer_old:
@@ -283,27 +322,43 @@ class TieredStore:
     # ------------------------------------------------------------------
     # disk tier
     # ------------------------------------------------------------------
-    def adopt(self, key: str, path: str, shape, dtype) -> None:
+    def adopt(self, key: str, path: str, shape, dtype,
+              tenant: str = "", shuffle: Optional[int] = None) -> None:
         """Register an EXISTING on-disk file (e.g. a checkpoint segment)
         as a disk-tier segment — no data is read until someone gets or
         prefetches it. The restart path: resume replays only segments
-        missing from the store, and even those lazily."""
+        missing from the store, and even those lazily. A ``tenant`` with
+        a registered account is charged disk bytes first (blocking while
+        over quota; no writer poke — disk frees only via deletes)."""
         dtype = np.dtype(dtype)
         nbytes = int(np.prod(shape)) * dtype.itemsize
-        seg = _Segment(key, shape, dtype, nbytes)
+        acct = None
+        if tenant:
+            with self._lock:
+                acct = self._accounts.get(tenant)
+        if acct is not None:
+            acct.charge("disk", nbytes)
+        seg = _Segment(key, shape, dtype, nbytes,
+                       tenant=tenant, shuffle=shuffle)
         seg.tier = "disk"
         seg.path = path
-        old_ev, defer_old = None, False
+        old = None
+        old_ev, defer_old, closed = None, False, False
         with self._lock:
             if self._closed:
-                raise RuntimeError("TieredStore is closed")
-            old = self._segments.pop(key, None)
-            if old is not None:
-                old_ev, defer_old = self._drop_locked(old)
-            self._tick += 1
-            seg.tick = self._tick
-            self._segments[key] = seg
-            self._disk_bytes += nbytes
+                closed = True
+            else:
+                old = self._segments.pop(key, None)
+                if old is not None:
+                    old_ev, defer_old = self._drop_locked(old)
+                self._tick += 1
+                seg.tick = self._tick
+                self._segments[key] = seg
+                self._disk_bytes += nbytes
+        if closed:
+            if acct is not None:
+                acct.release("disk", nbytes)
+            raise RuntimeError("TieredStore is closed")
         if old_ev is not None:
             old_ev.set()
         if old is not None and not defer_old:
@@ -342,14 +397,27 @@ class TieredStore:
             f"segment {seg.key!r} unreadable after "
             f"{self._reread_attempts} attempts: {last}") from last
 
-    def _promote_locked_install(self, key: str, data: np.ndarray) -> None:
-        """Install freshly-read bytes as the segment's host residence."""
+    def _promote_locked_install(self, key: str, data: np.ndarray) -> bool:
+        """Install freshly-read bytes as the segment's host residence.
+
+        Returns True iff installed. A promotion never BLOCKS on the
+        owning tenant's host quota — the data was already read for the
+        caller, residence is just a cache — so a tenant without host
+        headroom declines the install (``try_charge``) and the segment
+        stays on disk."""
+        with self._lock:
+            seg = self._segments.get(key)
+            if seg is None or seg.tier == "host":
+                return False
+            acct = self._accounts.get(seg.tenant) if seg.tenant else None
+        if acct is not None and not acct.try_charge("host", seg.nbytes):
+            return False
         lease = self.host_pool.get(data.nbytes)
         lease.view(data.dtype, data.shape)[...] = data
         stale: Optional[HostBuffer] = None
         with self._lock:
-            seg = self._segments.get(key)
-            if seg is None or seg.tier == "host":
+            cur = self._segments.get(key)
+            if cur is not seg or seg.tier == "host":
                 stale = lease         # raced with delete / another read
             else:
                 seg.tier = "host"
@@ -363,10 +431,16 @@ class TieredStore:
                 over = self._host_bytes > self._watermark
         if stale is not None:
             stale.release()
-            return
+            if acct is not None:
+                acct.release("host", seg.nbytes)
+            return False
+        if acct is not None:
+            # bytes moved disk -> host: return the disk-side charge
+            acct.release("disk", seg.nbytes)
         self._set_gauges()
         if over:
             self._wq.put("evict")
+        return True
 
     # ------------------------------------------------------------------
     # background threads
@@ -386,82 +460,127 @@ class TieredStore:
                 self._wq.task_done()
                 return
             try:
-                self._evict_until_under()
+                if isinstance(item, tuple) and item[0] == "tenant":
+                    self._evict_tenant(item[1])
+                else:
+                    self._evict_until_under()
             finally:
                 self._wq.task_done()
 
     def _evict_until_under(self) -> None:
-        from sparkrdma_tpu.obs.timeline import record_active
-
+        # victims whose tenant has no disk headroom are skipped for the
+        # rest of THIS sweep (the set resets next poke) — without the
+        # skip set a quota-refused LRU victim would be re-picked forever
+        skip: set = set()
         while True:
             with self._lock:
                 if self._closed or self._host_bytes <= self._watermark:
                     return
-                victims = [s for s in self._segments.values()
-                           if s.tier == "host" and not s.pinned
-                           and not s.wanted]
-                if not victims:
-                    return
-                seg = min(victims, key=lambda s: s.tick)
-                # mark in-flight so a concurrent get keeps working
-                # against the still-valid lease view
-                seg.pinned = True
-            try:
-                path = self._segment_path(seg.key)
-                write_array(path, seg.lease.view(seg.dtype, seg.shape),
-                            use_native=self._use_native,
-                            pool=self.host_pool)
-            except OSError:
-                # disk refused (no tier configured / full): leave the
-                # segment host-resident; data is never dropped — unless
-                # a concurrent put/delete already dropped it, in which
-                # case the lease was deferred to us and we release it
-                with self._lock:
-                    seg.pinned = False
-                    gone = self._segments.get(seg.key) is not seg
-                    if gone:
-                        lease, seg.lease = seg.lease, None
-                    else:
-                        lease = None
-                if lease is not None:
-                    lease.release()
+            if not self._evict_one(skip):
                 return
-            orphan = None
+
+    def _evict_tenant(self, tenant: str) -> None:
+        """One eviction on behalf of a quota-blocked put: demote that
+        tenant's OWN LRU host segment so its blocking host charge can
+        make progress. Never touches other tenants' segments — quota
+        pressure stays inside the tenant's blast radius."""
+        self._evict_one(set(), tenant=tenant)
+
+    def _evict_one(self, skip: set, tenant: Optional[str] = None) -> bool:
+        """Demote one LRU host segment to disk. Returns True when the
+        sweep should continue (a segment was demoted, or a victim was
+        skipped for quota), False when there is nothing left to do."""
+        from sparkrdma_tpu.obs.timeline import record_active
+
+        acct = None
+        with self._lock:
+            if self._closed:
+                return False
+            victims = [s for s in self._segments.values()
+                       if s.tier == "host" and not s.pinned
+                       and not s.wanted and s.key not in skip
+                       and (tenant is None or s.tenant == tenant)]
+            if not victims:
+                return False
+            seg = min(victims, key=lambda s: s.tick)
+            if seg.tenant:
+                acct = self._accounts.get(seg.tenant)
+            # a demotion moves the bytes into the owner's disk budget;
+            # an owner without disk headroom keeps the segment resident
+            # (non-blocking: the writer must never park on a quota)
+            if acct is not None and not acct.try_charge("disk", seg.nbytes):
+                skip.add(seg.key)
+                return True
+            # mark in-flight so a concurrent get keeps working
+            # against the still-valid lease view
+            seg.pinned = True
+        try:
+            path = self._segment_path(seg.key)
+            write_array(path, seg.lease.view(seg.dtype, seg.shape),
+                        use_native=self._use_native,
+                        pool=self.host_pool)
+        except OSError:
+            # disk refused (no tier configured / full): leave the
+            # segment host-resident; data is never dropped — unless
+            # a concurrent put/delete already dropped it, in which
+            # case the lease was deferred to us and we release it
             with self._lock:
-                still = self._segments.get(seg.key) is seg
-                if still and seg.wanted:
-                    # a prefetch claimed it mid-write: stay host-resident
-                    # (the written file is an orphan — remove it)
-                    seg.pinned = False
-                    lease = None
-                    orphan = path
-                elif still:
-                    seg.pinned = False
-                    seg.tier = "disk"
-                    seg.path = path
+                seg.pinned = False
+                gone = self._segments.get(seg.key) is not seg
+                if gone:
                     lease, seg.lease = seg.lease, None
-                    self._host_bytes -= seg.nbytes
-                    self._disk_bytes += seg.nbytes
                 else:
-                    # replaced or deleted mid-write: the dropper saw
-                    # pinned and deferred the lease to us (we were
-                    # reading it outside the lock); the file we just
-                    # wrote holds stale data for this key
-                    lease, seg.lease = seg.lease, None
-                    orphan = path
+                    lease = None
+            if acct is not None:
+                acct.release("disk", seg.nbytes)
             if lease is not None:
                 lease.release()
-            if orphan is not None:
-                try:
-                    os.remove(orphan)
-                except OSError:
-                    pass
-                continue
-            reg = _reg()
-            reg.counter("store.spill_writes").inc()
-            reg.counter("store.spill_bytes").inc(seg.nbytes)
-            record_active("spill:write", key=seg.key, bytes=seg.nbytes)
-            self._set_gauges()
+            return False
+        orphan = None
+        demoted = False
+        with self._lock:
+            still = self._segments.get(seg.key) is seg
+            if still and seg.wanted:
+                # a prefetch claimed it mid-write: stay host-resident
+                # (the written file is an orphan — remove it)
+                seg.pinned = False
+                lease = None
+                orphan = path
+            elif still:
+                seg.pinned = False
+                seg.tier = "disk"
+                seg.path = path
+                lease, seg.lease = seg.lease, None
+                self._host_bytes -= seg.nbytes
+                self._disk_bytes += seg.nbytes
+                demoted = True
+            else:
+                # replaced or deleted mid-write: the dropper saw
+                # pinned and deferred the lease to us (we were
+                # reading it outside the lock); the file we just
+                # wrote holds stale data for this key. The dropper
+                # also released the HOST charge (the tier at drop
+                # time) — only our speculative disk charge remains.
+                lease, seg.lease = seg.lease, None
+                orphan = path
+        if lease is not None:
+            lease.release()
+        if not demoted and acct is not None:
+            acct.release("disk", seg.nbytes)
+        if orphan is not None:
+            try:
+                os.remove(orphan)
+            except OSError:
+                pass
+            return True
+        if acct is not None:
+            acct.release("host", seg.nbytes)
+        reg = _reg()
+        reg.counter("store.spill_writes").inc()
+        reg.counter("store.spill_bytes").inc(seg.nbytes)
+        record_active("spill:write", key=seg.key, bytes=seg.nbytes)
+        self._set_gauges()
+        return True
 
     def _prefetch_loop(self) -> None:
         from sparkrdma_tpu.obs.timeline import record_active
@@ -492,11 +611,12 @@ class TieredStore:
                             seg.event = None
                         ev.set()
                         continue
-                    self._promote_locked_install(key, data)
-                    with self._lock:
-                        if self._segments.get(key) is seg:
-                            seg.promoted = True
-                    record_active("spill:promote", key=key, bytes=seg.nbytes)
+                    if self._promote_locked_install(key, data):
+                        with self._lock:
+                            if self._segments.get(key) is seg:
+                                seg.promoted = True
+                        record_active("spill:promote", key=key,
+                                      bytes=seg.nbytes)
                 with self._lock:
                     seg.event = None
                 ev.set()
@@ -532,15 +652,23 @@ class TieredStore:
                                     if self.pool is not None else 0),
             }
 
+    def occupancy_by_tenant(self) -> Dict[str, Dict[str, int]]:
+        """Tenant -> host/disk byte occupancy (heartbeat/rollup source;
+        key ``""`` aggregates untenanted segments)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for s in self._segments.values():
+                cell = out.setdefault(
+                    s.tenant, {"host_bytes": 0, "disk_bytes": 0})
+                cell["host_bytes" if s.tier == "host"
+                     else "disk_bytes"] += s.nbytes
+        return out
+
     def delete(self, key: str) -> None:
         with self._lock:
             seg = self._segments.pop(key, None)
             if seg is None:
                 return
-            if seg.tier == "host":
-                self._host_bytes -= seg.nbytes
-            else:
-                self._disk_bytes -= seg.nbytes
             ev, defer = self._drop_locked(seg)
         if ev is not None:
             ev.set()
@@ -548,15 +676,56 @@ class TieredStore:
             self._discard(seg)
         self._set_gauges()
 
+    def delete_shuffle(self, shuffle_id: int, tenant: str = "") -> None:
+        """Drop every segment tagged with ``shuffle_id`` (host leases
+        returned, store-owned disk files removed) — the unregister /
+        stop teardown hook that keeps a long-lived service from
+        accreting dead shuffles' spill bytes. ``tenant`` (optional)
+        additionally scopes the match, so one tenant's teardown can
+        never reap another's identically-numbered shuffle."""
+        with self._lock:
+            keys = [k for k, s in self._segments.items()
+                    if s.shuffle == shuffle_id
+                    and (not tenant or s.tenant == tenant)]
+        for key in keys:
+            self.delete(key)
+
+    def delete_tenant(self, tenant: str) -> None:
+        """Drop ALL segments owned by ``tenant`` and detach its account
+        (service-session teardown — the tenant's quota usage in this
+        store returns to zero via the per-segment releases)."""
+        if not tenant:
+            return
+        with self._lock:
+            keys = [k for k, s in self._segments.items()
+                    if s.tenant == tenant]
+        for key in keys:
+            self.delete(key)
+        with self._lock:
+            self._accounts.pop(tenant, None)
+
     def _drop_locked(self, seg: _Segment):
         """Detach ``seg`` as it leaves ``_segments`` (caller holds
-        ``_lock``). Returns ``(event, defer)``: the promotion event to
-        set once the lock is released — a ``get`` riding it would
-        otherwise park forever on a segment nobody will promote — and
-        whether lease cleanup must be deferred to the eviction writer
-        (``pinned`` means the writer is reading ``seg.lease`` outside
-        the lock right now; releasing it here would hand the buffer to
-        a new lease mid-read)."""
+        ``_lock``) — the single point where a departing segment's tier
+        bytes and tenant charge are returned, so replace paths cannot
+        leak accounting. Returns ``(event, defer)``: the promotion
+        event to set once the lock is released — a ``get`` riding it
+        would otherwise park forever on a segment nobody will promote —
+        and whether lease cleanup must be deferred to the eviction
+        writer (``pinned`` means the writer is reading ``seg.lease``
+        outside the lock right now; releasing it here would hand the
+        buffer to a new lease mid-read)."""
+        if seg.tier == "host":
+            self._host_bytes -= seg.nbytes
+        else:
+            self._disk_bytes -= seg.nbytes
+        if seg.tenant:
+            acct = self._accounts.get(seg.tenant)
+            if acct is not None:
+                # the account condition is a LEAF lock: the non-blocking
+                # release is safe under the store lock
+                acct.release("host" if seg.tier == "host" else "disk",
+                             seg.nbytes)
         ev, seg.event = seg.event, None
         defer = seg.pinned and seg.tier == "host" and seg.lease is not None
         return ev, defer
@@ -599,8 +768,9 @@ class TieredStore:
             self._closed = True
             segs = list(self._segments.values())
             self._segments.clear()
-            self._host_bytes = 0
-            self._disk_bytes = 0
+            # _drop_locked subtracts each segment's tier bytes (and
+            # returns its tenant charge) — zeroing the counters here
+            # too would double-subtract
             dropped = [self._drop_locked(s) for s in segs]
         for ev, _defer in dropped:
             if ev is not None:

@@ -92,7 +92,11 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: v6: + ``store_spill_bytes``/``store_fetch_bytes``/``store_prefetch_hits``
 #: /``store_sync_fetches`` — process-cumulative tiered-store totals
 #: (hbm/tiered_store.py), spill_count-style.
-SCHEMA_VERSION = 6
+#: v7: + ``tenant`` — the service tenant a span belongs to ("" outside
+#: the multi-tenant service); also carried by rollup cells and the
+#: auxiliary ``{"kind": "admission"}`` fair-queueing wait lines
+#: (sparkrdma_tpu/service/).
+SCHEMA_VERSION = 7
 
 
 @dataclasses.dataclass
@@ -144,6 +148,9 @@ class ExchangeSpan:
     store_fetch_bytes: int = 0
     store_prefetch_hits: int = 0
     store_sync_fetches: int = 0
+    # --- multi-tenant service identity (schema v7): "" when the read
+    # ran outside a service session (single-tenant compat) ---
+    tenant: str = ""
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

@@ -64,6 +64,10 @@ COUNTERS = frozenset({
     "store.prefetch_hits",
     "store.sync_fetches",
     "store.crc_rereads",
+    "service.admits",
+    "service.admission_waits",
+    "service.sessions_opened",
+    "service.sessions_closed",
 })
 
 #: Point-in-time gauges (``registry.gauge(name)``).
@@ -73,6 +77,7 @@ GAUGES = frozenset({
     "reads.in_flight",
     "store.host_bytes",
     "store.disk_bytes",
+    "service.tenants",
 })
 
 #: Distributions (``registry.histogram(name)``).
@@ -104,6 +109,10 @@ WILDCARDS = frozenset({
     "serde.*_calls",
     "serde.*_native",
     "serde.*_fallback",
+    "tenant.*.hbm_slots",
+    "tenant.*.host_bytes",
+    "tenant.*.disk_bytes",
+    "tenant.*.quota_waits",
 })
 
 __all__ = ["COUNTERS", "GAUGES", "HISTOGRAMS", "TIMELINE_TRACKS",

@@ -50,7 +50,7 @@ LATENCY_BOUNDS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 
 #: every key a ``{"kind": "rollup"}`` line carries (lint-pinned)
 ROLLUP_FIELDS = frozenset({
-    "kind", "schema", "ts", "process_index", "shuffle_id",
+    "kind", "schema", "ts", "process_index", "shuffle_id", "tenant",
     "window_start", "window_s",
     "reads", "sampled_reads", "records", "bytes", "rounds", "dispatches",
     "retries", "spills", "streaming_reads", "fused_reads",
@@ -66,7 +66,7 @@ ROLLUP_FIELDS = frozenset({
 HEARTBEAT_FIELDS = frozenset({
     "kind", "schema", "ts", "seq", "process_index", "host_count", "host",
     "pid", "uptime_s", "in_flight", "pool_outstanding", "spans_emitted",
-    "rotations", "rss_mb", "host_tier_mb", "disk_tier_mb",
+    "rotations", "rss_mb", "host_tier_mb", "disk_tier_mb", "tenants",
 })
 
 
@@ -136,7 +136,9 @@ class RollupAggregator:
         self._clock = clock
         self._lock = threading.Lock()
         self._window_start: Optional[float] = None   # guarded-by: _lock
-        self._cells: Dict[int, _Cell] = {}           # guarded-by: _lock
+        # keyed by (tenant, shuffle_id): one cell per tenant per shuffle,
+        # so two tenants' identically-numbered shuffles never merge
+        self._cells: Dict[tuple, _Cell] = {}         # guarded-by: _lock
         # spill_count is process-cumulative
         self._last_spill = 0                         # guarded-by: _lock
         # serde codec totals are process-cumulative too (schema v4);
@@ -158,9 +160,10 @@ class RollupAggregator:
             b += 1
         with self._lock:
             pending = self._roll_locked(now)
-            cell = self._cells.get(span.shuffle_id)
+            ckey = (span.tenant, span.shuffle_id)
+            cell = self._cells.get(ckey)
             if cell is None:
-                cell = self._cells[span.shuffle_id] = _Cell()
+                cell = self._cells[ckey] = _Cell()
             cell.reads += 1
             if kept:
                 cell.sampled_reads += 1
@@ -232,14 +235,15 @@ class RollupAggregator:
         lines *outside* ``_lock`` so slow journal I/O never extends the
         aggregator's critical section."""
         pending: List[Dict] = []
-        for sid in sorted(self._cells):
-            c = self._cells[sid]
+        for tenant, sid in sorted(self._cells):
+            c = self._cells[(tenant, sid)]
             d = {
                 "kind": "rollup",
                 "schema": SCHEMA_VERSION,
                 "ts": now,
                 "process_index": self.process_index,
                 "shuffle_id": sid,
+                "tenant": tenant,
                 "window_start": self._window_start,
                 "window_s": self.window_s,
                 "reads": c.reads,
@@ -361,6 +365,17 @@ class HeartbeatEmitter:
         except Exception:
             return -1
 
+    def _probe_raw(self, name: str):
+        """Structured-valued probe (the per-tenant usage dict) — ``{}``
+        when absent or failing; int coercion would mangle the value."""
+        fn = self._probes.get(name)
+        if fn is None:
+            return {}
+        try:
+            return fn()
+        except Exception:
+            return {}
+
     def beat(self, now: Optional[float] = None) -> None:   # never-raises
         try:
             now = self._clock() if now is None else now
@@ -385,6 +400,8 @@ class HeartbeatEmitter:
                 "rss_mb": rss_mb(),
                 "host_tier_mb": self._probe("host_tier_mb"),
                 "disk_tier_mb": self._probe("disk_tier_mb"),
+                # tenant -> per-tier usage (empty outside the service)
+                "tenants": self._probe_raw("tenants"),
             }
             if set(d) != HEARTBEAT_FIELDS:
                 # must survive python -O; caught + counted just below

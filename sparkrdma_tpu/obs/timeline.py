@@ -143,6 +143,11 @@ NULL_TIMELINE = _NullTimeline()
 # ---------------------------------------------------------------------
 _active_lock = threading.Lock()
 _active: Optional[EventTimeline] = None
+#: thread-local overlay: a tenant session's timeline, installed around
+#: its SPI calls so one tenant's in-span events never land in another
+#: tenant's journal lines (blast-radius isolation for shared machinery
+#: like the tiered store's sync-fetch markers)
+_tls = threading.local()
 
 
 def set_active(tl: Optional[EventTimeline]) -> Optional[EventTimeline]:
@@ -153,12 +158,36 @@ def set_active(tl: Optional[EventTimeline]) -> Optional[EventTimeline]:
     return prev
 
 
+class scoped_active:
+    """Context manager: install ``tl`` as the CURRENT THREAD's active
+    timeline (restores the prior thread scope on exit); while scoped,
+    :func:`record_active` prefers it over the process-wide timeline.
+    ``scoped_active(None)`` is a pass-through."""
+
+    def __init__(self, tl: Optional[EventTimeline]):
+        self._tl = tl
+        self._prev: Optional[EventTimeline] = None
+
+    def __enter__(self) -> "scoped_active":
+        if self._tl is not None:
+            self._prev = getattr(_tls, "timeline", None)
+            _tls.timeline = self._tl
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._tl is not None:
+            _tls.timeline = self._prev
+
+
 def record_active(name: str, ph: str = "i", **extras) -> None:
-    """Record into the active timeline, if any (no-op otherwise)."""
-    tl = _active
+    """Record into the active timeline, if any (no-op otherwise). A
+    thread-scoped timeline (tenant session) takes precedence."""
+    tl = getattr(_tls, "timeline", None)
+    if tl is None:
+        tl = _active
     if tl is not None:
         tl.event(name, ph=ph, **extras)
 
 
 __all__ = ["EventTimeline", "NULL_TIMELINE", "DEFAULT_CAPACITY",
-           "set_active", "record_active"]
+           "set_active", "scoped_active", "record_active"]

@@ -1,0 +1,20 @@
+"""Multi-tenant shuffle service (the external-shuffle-service analogue).
+
+One long-lived :class:`~sparkrdma_tpu.service.daemon.ShuffleService`
+owns the process singletons — MeshRuntime, HBM slot pool, the tiered
+store, the journal identity — and admits many concurrent tenant
+clients, each holding a tenant-scoped ShuffleManager-compatible SPI
+handle. Per-tenant quotas span all three storage tiers
+(:mod:`~sparkrdma_tpu.service.tenant`), and a deficit-round-robin
+admission controller (:mod:`~sparkrdma_tpu.service.admission`) keeps
+one tenant's oversubscribed terasort from starving another's small
+join.
+"""
+
+from sparkrdma_tpu.service.admission import AdmissionController
+from sparkrdma_tpu.service.daemon import ShuffleService
+from sparkrdma_tpu.service.tenant import (QuotaExceededError, TenantAccount,
+                                          TenantQuota, TenantRegistry)
+
+__all__ = ["ShuffleService", "AdmissionController", "TenantAccount",
+           "TenantQuota", "TenantRegistry", "QuotaExceededError"]

@@ -1,0 +1,213 @@
+"""The long-lived shuffle-service daemon — process singletons + sessions.
+
+The reference deploys ``RdmaShuffleManager`` in two roles: executors
+hold per-app instances, while the external shuffle service is ONE
+long-lived process serving blocks to many applications across executor
+restarts. :class:`ShuffleService` is that second role, TPU-native: one
+daemon owns the process singletons no two tenants can each have —
+
+- the :class:`~sparkrdma_tpu.runtime.mesh.MeshRuntime` (the device mesh
+  and its HBM :class:`~sparkrdma_tpu.hbm.slot_pool.SlotPool`),
+- the :class:`~sparkrdma_tpu.hbm.tiered_store.TieredStore` (host-pin
+  budget and disk spill root are machine resources),
+- the journal identity (one ``metrics_sink`` writer per process),
+
+and admits many concurrent tenants. ``open_session(tenant)`` returns a
+tenant-scoped :class:`~sparkrdma_tpu.api.shuffle_manager.ShuffleManager`
+— the full five-method SPI, unchanged for existing callers — wired to
+the shared singletons plus that tenant's
+:class:`~sparkrdma_tpu.service.tenant.TenantAccount` (three-tier
+quotas) and the shared deficit-round-robin
+:class:`~sparkrdma_tpu.service.admission.AdmissionController`.
+
+Isolation contract: a tenant's fault schedule, degradation ladder and
+retry state live in its session's plane and reach the module-level
+fault sites only through thread-local scoping
+(:func:`sparkrdma_tpu.faults.scoped_plane`), so one tenant's chaos
+never fires inside another's shuffle; spans/rollups/heartbeats carry
+the tenant name so the observability pipeline separates them after the
+fact; exec-cache keys fold the tenant in so compiled programs are never
+shared across quota domains.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.hbm.tiered_store import TieredStore
+from sparkrdma_tpu.obs.journal import ExchangeJournal
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.rollup import HeartbeatEmitter
+from sparkrdma_tpu.runtime.mesh import MeshRuntime
+from sparkrdma_tpu.service.admission import AdmissionController
+from sparkrdma_tpu.service.tenant import (TenantAccount, TenantQuota,
+                                          TenantRegistry)
+
+
+class ShuffleService:
+    """One per host — owns the singletons, hands out tenant sessions."""
+
+    def __init__(self, runtime: Optional[MeshRuntime] = None,
+                 conf: Optional[ShuffleConf] = None):
+        self.runtime = runtime or MeshRuntime(conf)
+        self.conf = conf or self.runtime.conf
+        self.metrics = MetricsRegistry(
+            enabled=(self.conf.collect_shuffle_read_stats
+                     or bool(self.conf.metrics_sink)))
+        sink = self.conf.metrics_sink
+        if isinstance(sink, str) and "{process}" in sink:
+            sink = sink.replace("{process}",
+                                str(self.runtime.process_index))
+        self.journal = ExchangeJournal(
+            sink, metrics=self.metrics,
+            max_bytes=self.conf.journal_max_bytes)
+        # ONE tiered store for the host: the pinned-host budget and the
+        # spill directory are per-machine resources; tenants share them
+        # under their accounts' quotas rather than racing blind.
+        self.tiered = TieredStore(self.conf, pool=self.runtime.pool)
+        self.tenants = TenantRegistry(metrics=self.metrics,
+                                      wait_s=self.conf.admission_wait_s)
+        self.admission = AdmissionController(
+            quantum=self.conf.admission_quantum,
+            max_concurrent=self.conf.admission_slots,
+            wait_s=self.conf.admission_wait_s,
+            journal=self.journal, metrics=self.metrics)
+        if self.runtime.pool is not None:
+            self.runtime.pool.metrics = self.metrics
+        self._lock = threading.Lock()
+        self._sessions: List[ShuffleManager] = []   # guarded-by: _lock
+        self._closed = False                        # guarded-by: _lock
+        # the daemon owns THE heartbeat; its per-tenant usage probe is
+        # what shuffle_top's tenant view reads back out of the journal
+        self.heartbeat = None
+        if self.journal.enabled and self.conf.heartbeat_s > 0:
+            pool = self.runtime.pool
+            self.heartbeat = HeartbeatEmitter(
+                self.journal, self.conf.heartbeat_s,
+                identity=self.runtime.process_identity(),
+                probes={
+                    "in_flight": self._reads_in_flight,
+                    "pool_outstanding": (
+                        lambda: pool.outstanding if pool is not None
+                        else 0),
+                    "host_tier_mb": (
+                        lambda: self.tiered.occupancy()["host_bytes"]
+                        // (1 << 20)),
+                    "disk_tier_mb": (
+                        lambda: self.tiered.occupancy()["disk_bytes"]
+                        // (1 << 20)),
+                    "tenants": self.tenants.usage_by_tenant,
+                })
+            self.heartbeat.start()
+
+    # --- tenant lifecycle ---------------------------------------------
+    def register_tenant(self, name: str,
+                        quota: Optional[TenantQuota] = None
+                        ) -> TenantAccount:
+        """Create (or re-scope) a tenant; idempotent.
+
+        ``quota=None`` takes the service defaults from the conf
+        (``tenant_hbm_slots`` / ``tenant_host_bytes`` /
+        ``tenant_disk_bytes``; 0 = unlimited in that tier).
+        """
+        if quota is None:
+            quota = TenantQuota(
+                hbm_slots=self.conf.tenant_hbm_slots,
+                host_bytes=self.conf.tenant_host_bytes,
+                disk_bytes=self.conf.tenant_disk_bytes)
+        acct = self.tenants.register(name, quota)
+        # the store enforces host/disk charges by tenant tag, so it
+        # needs the account installed under the tenant's name
+        self.tiered.register_account(name, acct)
+        self.metrics.gauge("service.tenants").set(
+            len(self.tenants.names()))
+        return acct
+
+    def open_session(self, tenant: str,
+                     conf: Optional[ShuffleConf] = None) -> ShuffleManager:
+        """Admit ``tenant`` and return its SPI handle.
+
+        The returned manager IS a :class:`ShuffleManager` — the five SPI
+        methods behave identically — but scoped: shared runtime/store/
+        journal (never closed by its ``stop()``), tenant-tagged spans
+        and store segments, quota-enforced tier allocations, admission-
+        controlled reads. ``conf`` lets a tenant bring its own knobs
+        (fault schedule, transport, sort options); geometry comes from
+        the shared runtime regardless.
+        """
+        acct = self.tenants.get(tenant)
+        if acct is None:
+            acct = self.register_tenant(tenant)
+        else:
+            # a prior session's stop() tore the tenant's store state
+            # down (delete_tenant pops the account) — re-install
+            self.tiered.register_account(tenant, acct)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ShuffleService is stopped")
+        m = ShuffleManager(self.runtime, conf or self.conf,
+                           tenant=tenant, tiered=self.tiered,
+                           journal=self.journal,
+                           admission=self.admission, account=acct)
+        with self._lock:
+            self._sessions.append(m)
+        self.metrics.counter("service.sessions_opened").inc()
+        return m
+
+    def close_session(self, manager: ShuffleManager) -> None:
+        """Tear down one tenant session (drops its store segments)."""
+        with self._lock:
+            try:
+                self._sessions.remove(manager)
+            except ValueError:
+                pass
+        manager.stop()
+        self.metrics.counter("service.sessions_closed").inc()
+
+    # --- observability -------------------------------------------------
+    def _reads_in_flight(self) -> int:
+        with self._lock:
+            sessions = list(self._sessions)
+        return sum(m._reads_in_flight for m in sessions)
+
+    def usage_by_tenant(self) -> Dict[str, Dict[str, int]]:
+        return self.tenants.usage_by_tenant()
+
+    def stats(self) -> dict:
+        with self._lock:
+            open_sessions = len(self._sessions)
+        return {
+            "tenants": self.tenants.names(),
+            "sessions": open_sessions,
+            "admission": self.admission.stats(),
+            "store": self.tiered.occupancy_by_tenant(),
+        }
+
+    # --- lifecycle ------------------------------------------------------
+    def stop(self) -> None:
+        """Stop the daemon: close straggler sessions, then singletons."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            stragglers = list(self._sessions)
+            self._sessions.clear()
+        for m in stragglers:
+            m.stop()
+        if self.heartbeat is not None:
+            self.heartbeat.stop()       # emits one final beat
+        self.journal.close()
+        self.tiered.close()
+        self.runtime.stop()
+
+    def __enter__(self) -> "ShuffleService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+__all__ = ["ShuffleService"]

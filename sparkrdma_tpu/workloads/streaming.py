@@ -263,7 +263,12 @@ def run_tiered_terasort(
         segs = []
         for j in range(n_chunks):
             chunk = cols[:, j * chunk_records:(j + 1) * chunk_records]
-            store.put(keys[j], chunk)
+            # tenant-tagged for quota attribution, but NOT shuffle-tagged:
+            # the staged input chunks are this workload's own working set
+            # (deleted per-round below), not any exchange's map output —
+            # a shuffle tag would let unregister_shuffle of a same-id
+            # exchange drop chunks the streamer still needs
+            store.put(keys[j], chunk, tenant=manager.tenant)
             if checkpoint:
                 segs.append((keys[j], chunk))
         if checkpoint:
@@ -299,7 +304,11 @@ def run_tiered_terasort(
     records = 0
     for j, chunk in enumerate(streamer):
         records += chunk.shape[1]
-        handle = manager.register_shuffle(shuffle_id_base + j, mesh, part)
+        # exchange ids start at base+1: resume mode adopts the staged
+        # chunk segments under shuffle id ``shuffle_id_base`` itself,
+        # and round 0's unregister must not tear that family down
+        handle = manager.register_shuffle(shuffle_id_base + 1 + j, mesh,
+                                          part)
         try:
             manager.get_writer(handle).write(chunk).stop(True)
             # record_stats=True: each chunk's span carries the store's
@@ -319,7 +328,7 @@ def run_tiered_terasort(
             else:
                 barrier(out)
         finally:
-            manager.unregister_shuffle(shuffle_id_base + j)
+            manager.unregister_shuffle(shuffle_id_base + 1 + j)
             # round k's consumed chunk leaves the store; the background
             # writer stops considering it, bounding occupancy
             store.delete(keys[j])

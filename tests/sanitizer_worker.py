@@ -1,6 +1,7 @@
 """Child-process body for the sanitizer test legs.
 
-Run as ``python tests/sanitizer_worker.py {probe|fuzz|planes}`` with
+Run as ``python tests/sanitizer_worker.py {probe|fuzz|planes|tenants}``
+with
 ``SPARKRDMA_NATIVE_FLAVOR=tsan|asan`` set and the matching sanitizer
 runtime LD_PRELOADed — ``tests/test_sanitizers.py`` does both. The
 point of a separate script (deliberately NOT named ``test_*.py``, so
@@ -21,6 +22,11 @@ wanted-flag races, spill I/O through the instrumented native file
 path), StallWatchdog arm/disarm, HeartbeatEmitter start/stop — under
 TSan, so a race between foreground callers and the background threads
 surfaces as a sanitizer report instead of a once-a-week flake.
+``tenants`` churns the multi-tenant service plane — N tenant threads
+register/admit/put/read/unregister against ONE shared tiered store,
+tenant registry and admission controller with tight quotas, so the
+quota condition variables, the deficit-round-robin grant loop and the
+quota-aware eviction path get raced under TSan the same way.
 
 Exit codes: 0 ok, 3 native codec unavailable (parent skips), anything
 else — including a sanitizer runtime's own failure exit — fails the leg.
@@ -230,6 +236,82 @@ def _store_plane(np) -> None:
         store.close(delete_disk=True)
 
 
+def _tenant_plane(np) -> None:
+    """Multi-tenant service churn against ONE shared store + registry +
+    admission controller, tight quotas. Each tenant thread loops the
+    session lifecycle — register, admit (DRR ticket), publish segments
+    under quota (blocking charges poke the eviction writer), read them
+    back bit-exact, unregister — while its siblings do the same, so the
+    TenantAccount condition variable, the controller's grant loop and
+    the store's quota-aware eviction race each other under TSan."""
+    import threading
+
+    from sparkrdma_tpu.config import ShuffleConf
+    from sparkrdma_tpu.hbm.tiered_store import TieredStore
+    from sparkrdma_tpu.service.admission import AdmissionController
+    from sparkrdma_tpu.service.tenant import (QuotaExceededError,
+                                              TenantQuota, TenantRegistry)
+
+    with tempfile.TemporaryDirectory() as td:
+        conf = ShuffleConf(spill_tier_dir=td,
+                           spill_tier_host_bytes=1 << 14)
+        store = TieredStore(conf)
+        registry = TenantRegistry(wait_s=10.0)
+        adm = AdmissionController(quantum=2.0, max_concurrent=2,
+                                  wait_s=60.0)
+        quota = TenantQuota(host_bytes=1 << 13, disk_bytes=1 << 16)
+        errors: list = []
+
+        def tenant_churn(i: int) -> None:
+            name = f"t{i}"
+            rng = np.random.default_rng(500 + i)
+            try:
+                for rnd in range(12):
+                    acct = registry.register(name, quota)
+                    store.register_account(name, acct)
+                    with adm.admit(name, cost=int(rng.integers(1, 5))):
+                        kept = []
+                        for j in range(6):
+                            arr = np.full(
+                                (4, int(rng.integers(32, 256))),
+                                i * 1000 + j, np.uint32)
+                            key = f"{name}.r{rnd}.s{j}"
+                            try:
+                                store.put(key, arr, tenant=name,
+                                          shuffle=rnd)
+                                kept.append((key, arr))
+                            except QuotaExceededError:
+                                pass   # fail-clean under pressure
+                        for key, arr in kept:
+                            assert (store.get(key) == arr).all(), \
+                                f"corrupt read of {key}"
+                        u = acct.usage()
+                        assert u["host"] <= quota.host_bytes
+                        assert u["disk"] <= quota.disk_bytes
+                    store.delete_shuffle(rnd, tenant=name)
+                store.delete_tenant(name)
+                registry.remove(name)
+            except Exception as e:   # surfaced after join
+                errors.append(e)
+
+        workers = [threading.Thread(target=tenant_churn, args=(i,),
+                                    name=f"tenant-{i}")
+                   for i in range(4)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        if errors:
+            raise errors[0]
+        store.drain()
+        # every tenant tore itself down: ledgers and tiers must be empty
+        assert store.occupancy_by_tenant() == {}
+        occ = store.occupancy()
+        assert occ["host_bytes"] == 0 and occ["disk_bytes"] == 0
+        assert adm.stats()["active"] == 0
+        store.close(delete_disk=True)
+
+
 def _watchdog_plane(np) -> None:
     """StallWatchdog arm/disarm churn racing the timer thread: short
     enough timeouts that some timers genuinely fire mid-churn while
@@ -337,7 +419,13 @@ def main(mode: str) -> int:
               f"(flavor={hs.native_flavor() or 'plain'})")
         return 0
 
-    print(f"unknown mode {mode!r} (expected probe|fuzz|planes)",
+    if mode == "tenants":
+        _tenant_plane(np)
+        print("sanitizer worker: tenants ok "
+              f"(flavor={hs.native_flavor() or 'plain'})")
+        return 0
+
+    print(f"unknown mode {mode!r} (expected probe|fuzz|planes|tenants)",
           file=sys.stderr)
     return 2
 

@@ -361,6 +361,7 @@ class TestSchemaV2V3:
             "backoff_ms", "degraded",          # v5: recovery hardening
             "store_spill_bytes", "store_fetch_bytes",   # v6: tiered store
             "store_prefetch_hits", "store_sync_fetches",
+            "tenant",                          # v7: multi-tenant service
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)

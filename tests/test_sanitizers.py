@@ -109,3 +109,14 @@ def test_tsan_thread_planes_leg():
     timer thread, HeartbeatEmitter start/stop against foreground
     beats."""
     _leg("tsan", "WARNING: ThreadSanitizer", mode="planes")
+
+
+@pytest.mark.slow
+def test_tsan_tenant_churn_leg():
+    """The multi-tenant service plane under ThreadSanitizer: N tenant
+    threads register/admit/put/read/unregister against one shared
+    tiered store + tenant registry + admission controller with tight
+    quotas — the TenantAccount condition variable, the deficit-round-
+    robin grant loop and the quota-aware eviction path racing each
+    other."""
+    _leg("tsan", "WARNING: ThreadSanitizer", mode="tenants")

@@ -1,0 +1,312 @@
+"""Multi-tenant ShuffleService tests.
+
+The acceptance contract for the service subsystem, pinned:
+
+- two tenants running through ONE ShuffleService produce outputs
+  bit-identical to a serial single-tenant (standalone ShuffleManager)
+  run of the same dataset;
+- an over-subscribed tenant QUEUES — journaled ``admission`` wait lines
+  — rather than failing or starving;
+- per-tenant usage never exceeds quota in any tier, and the per-tenant
+  ledgers sum exactly to the shared store's pool totals once the
+  eviction writer quiesces;
+- ``unregister_shuffle``/session ``stop()`` drop the shuffle's/tenant's
+  remaining tiered-store segments (the teardown leak fix) without
+  touching anyone else's.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import faults as _faults
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+from sparkrdma_tpu.service import (QuotaExceededError, ShuffleService,
+                                   TenantQuota)
+
+MESH = 8
+
+
+def _records(rng, n_rows=8 * 32, words=4):
+    return rng.integers(1, 2**32, size=(n_rows, words), dtype=np.uint32)
+
+
+def test_two_tenants_bit_identical_to_solo(rng):
+    """Concurrent tenants through one service == serial standalone runs."""
+    x = _records(rng)
+    part = modulo_partitioner(MESH)
+
+    solo = ShuffleManager(conf=ShuffleConf(slot_records=64))
+    h = solo.register_shuffle(21, MESH, part)
+    solo.get_writer(h).write(solo.runtime.shard_records(x)).stop(True)
+    ref_out, ref_tot = solo.get_reader(h).read()
+    ref_out = np.asarray(ref_out).copy()
+    ref_tot = np.asarray(ref_tot).copy()
+    solo.unregister_shuffle(21)
+    solo.stop()
+
+    svc = ShuffleService(conf=ShuffleConf(slot_records=64))
+    results: dict = {}
+    errors: list = []
+    start = threading.Barrier(2)
+
+    def run(tenant):
+        try:
+            m = svc.open_session(tenant)
+            hh = m.register_shuffle(21, MESH, part)
+            m.get_writer(hh).write(m.runtime.shard_records(x)).stop(True)
+            start.wait(timeout=60)
+            for _ in range(3):   # overlap reads across tenants
+                out, tot = m.get_reader(hh).read()
+            results[tenant] = (np.asarray(out).copy(),
+                               np.asarray(tot).copy())
+            m.unregister_shuffle(21)
+            svc.close_session(m)
+        except Exception as e:           # surfaced below, not swallowed
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in ("alice", "bob")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    svc.stop()
+    assert not errors, errors
+    for tenant in ("alice", "bob"):
+        out, tot = results[tenant]
+        np.testing.assert_array_equal(tot, ref_tot)
+        np.testing.assert_array_equal(out, ref_out)
+
+
+def test_oversubscribed_tenant_queues_not_fails(tmp_path, rng):
+    """admission_slots=1 + two reading tenants: both complete, the
+    contention is journaled as ``admission`` wait lines, spans carry the
+    tenant name, and the daemon heartbeat reports per-tenant usage."""
+    sink = tmp_path / "journal.jsonl"
+    conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                       heartbeat_s=3600.0,   # beat() driven manually
+                       admission_slots=1, admission_quantum=4.0,
+                       admission_wait_s=120.0)
+    svc = ShuffleService(conf=conf)
+    part = modulo_partitioner(MESH)
+    x = _records(rng)
+    errors: list = []
+    start = threading.Barrier(2)
+
+    def run(tenant, sid):
+        try:
+            m = svc.open_session(tenant)
+            hh = m.register_shuffle(sid, MESH, part)
+            m.get_writer(hh).write(m.runtime.shard_records(x)).stop(True)
+            start.wait(timeout=60)
+            for _ in range(4):
+                m.get_reader(hh).read()
+            m.unregister_shuffle(sid)
+            svc.close_session(m)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=("alice", 31)),
+               threading.Thread(target=run, args=("bob", 32))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert svc.heartbeat is not None
+    svc.heartbeat.beat()
+    svc.stop()
+    assert not errors, errors
+
+    lines = [json.loads(ln) for ln in
+             sink.read_text().splitlines() if ln.strip()]
+    waits = [d for d in lines if d.get("kind") == "admission"
+             and d.get("event") == "wait"]
+    assert waits, ("two tenants through a 1-slot controller must queue "
+                   "and journal the waits")
+    assert {d["tenant"] for d in waits} <= {"alice", "bob"}
+    assert all(d["wait_ms"] > 0 for d in waits)
+    spans = [d for d in lines if d.get("kind") in (None, "span")
+             and "span_id" in d]
+    assert {"alice", "bob"} <= {d.get("tenant") for d in spans}
+    beats = [d for d in lines if d.get("kind") == "heartbeat"]
+    assert beats and {"alice", "bob"} <= set(beats[-1]["tenants"])
+
+
+def test_tenant_usage_invariants_under_random_ops(tmp_path):
+    """Property test: under seeded random multi-tenant store ops, no
+    tenant's host/disk ledger ever exceeds its quota, and once the
+    eviction writer quiesces the per-tenant ledgers sum exactly to the
+    store's pool totals."""
+    conf = ShuffleConf(slot_records=64,
+                       spill_tier_dir=str(tmp_path / "tier"),
+                       spill_tier_host_bytes=1 << 15,
+                       admission_wait_s=0.2,
+                       tenant_host_bytes=1 << 14,
+                       tenant_disk_bytes=1 << 16)
+    svc = ShuffleService(conf=conf)
+    st = svc.tiered
+    tenants = ["t0", "t1", "t2"]
+    accts = {t: svc.register_tenant(t) for t in tenants}
+
+    def check_quota():
+        for t in tenants:
+            u = accts[t].usage()
+            assert u["host"] <= conf.tenant_host_bytes, (t, u)
+            assert u["disk"] <= conf.tenant_disk_bytes, (t, u)
+
+    rng = np.random.default_rng(7)
+    live: dict = {t: [] for t in tenants}
+    denials = 0
+    for step in range(150):
+        t = tenants[int(rng.integers(len(tenants)))]
+        op = float(rng.random())
+        if op < 0.6:
+            n = int(rng.integers(64, 1024))
+            arr = np.full((4, n), step, np.uint32)
+            key = f"{t}.k{step}"
+            try:
+                st.put(key, arr, tenant=t, shuffle=step % 3)
+                live[t].append(key)
+            except QuotaExceededError:
+                denials += 1      # fail-clean, never a wedge or a leak
+        elif op < 0.85 and live[t]:
+            st.delete(live[t].pop(int(rng.integers(len(live[t])))))
+        elif live[t]:
+            key = live[t][int(rng.integers(len(live[t])))]
+            got = st.get(key)
+            assert int(got[0, 0]) == int(key.split("k")[-1])
+        check_quota()
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        by_t = st.occupancy_by_tenant()
+        tot = st.occupancy()
+        if (sum(d["host_bytes"] for d in by_t.values())
+                == tot["host_bytes"]
+                and sum(d["disk_bytes"] for d in by_t.values())
+                == tot["disk_bytes"]):
+            break
+        time.sleep(0.02)
+    by_t = st.occupancy_by_tenant()
+    tot = st.occupancy()
+    assert sum(d["host_bytes"] for d in by_t.values()) == tot["host_bytes"]
+    assert sum(d["disk_bytes"] for d in by_t.values()) == tot["disk_bytes"]
+    check_quota()
+    # ledgers agree with the accounts' own view, tier by tier
+    for t in tenants:
+        u = accts[t].usage()
+        o = by_t.get(t, {"host_bytes": 0, "disk_bytes": 0})
+        assert u["host"] == o["host_bytes"]
+        assert u["disk"] == o["disk_bytes"]
+    svc.stop()
+
+
+def test_hbm_slot_quota_blocks_then_releases():
+    conf = ShuffleConf(slot_records=64, admission_wait_s=0.1,
+                       tenant_hbm_slots=2)
+    svc = ShuffleService(conf=conf)
+    pool = svc.runtime.pool
+    if pool is None:
+        svc.stop()
+        pytest.skip("runtime has no slot pool")
+    acct = svc.register_tenant("t")
+    s1 = pool.get(64, account=acct)
+    s2 = pool.get(64, account=acct)
+    assert acct.usage()["hbm"] == 2
+    with pytest.raises(QuotaExceededError):
+        pool.get(64, account=acct)
+    assert acct.usage()["hbm"] == 2   # failed acquire left no charge
+    s1.release()
+    s3 = pool.get(64, account=acct)   # freed slot re-acquirable
+    assert acct.usage()["hbm"] == 2
+    s2.release()
+    s3.release()
+    assert acct.usage()["hbm"] == 0
+    svc.stop()
+
+
+def test_unregister_drops_tiered_segments(tmp_path):
+    """The teardown leak fix — single-tenant path: unregister_shuffle
+    drops the shuffle's remaining tiered segments (host leases + disk
+    files), leaving other shuffles' segments alone."""
+    conf = ShuffleConf(slot_records=64,
+                       spill_tier_dir=str(tmp_path / "tier"))
+    m = ShuffleManager(conf=conf)
+    a = np.ones((4, 256), np.uint32)
+    m.tiered.put("sh9.c0", a, shuffle=9)
+    m.tiered.put("sh9.c1", a, shuffle=9)
+    m.tiered.put("sh10.c0", a, shuffle=10)
+    part = modulo_partitioner(MESH)
+    m.register_shuffle(9, MESH, part)
+    assert m.tiered.occupancy()["host_bytes"] == 3 * a.nbytes
+    m.unregister_shuffle(9)
+    assert not m.tiered.contains("sh9.c0")
+    assert not m.tiered.contains("sh9.c1")
+    assert m.tiered.contains("sh10.c0")
+    assert m.tiered.occupancy()["host_bytes"] == a.nbytes
+    m.stop()
+
+
+def test_session_stop_drops_only_its_tenant(tmp_path):
+    conf = ShuffleConf(slot_records=64,
+                       spill_tier_dir=str(tmp_path / "tier"))
+    svc = ShuffleService(conf=conf)
+    ma = svc.open_session("a")
+    mb = svc.open_session("b")
+    arr = np.ones((4, 128), np.uint32)
+    ma.tiered.put("a.k", arr, tenant="a", shuffle=1)
+    mb.tiered.put("b.k", arr, tenant="b", shuffle=1)
+    svc.close_session(ma)
+    assert not svc.tiered.contains("a.k")
+    assert svc.tiered.contains("b.k")
+    occ = svc.tiered.occupancy_by_tenant()
+    assert "a" not in occ
+    assert occ["b"]["host_bytes"] == arr.nbytes
+    # singletons survived the session teardown: b still reads its data
+    np.testing.assert_array_equal(svc.tiered.get("b.k"), arr)
+    svc.close_session(mb)
+    svc.stop()
+
+
+def test_session_fault_plane_stays_thread_local():
+    """Blast-radius isolation: a tenant session's fault plane is never
+    installed process-wide — it reaches the module-level fault sites
+    only inside that session's _tenant_scope()."""
+    svc = ShuffleService(conf=ShuffleConf(slot_records=64))
+    before = _faults.active_plane()
+    fconf = ShuffleConf(slot_records=64,
+                        fault_spec="exchange.dispatch:fail@attempt<1;")
+    m = svc.open_session("chaotic", conf=fconf)
+    try:
+        assert m.faults.enabled
+        assert _faults.active_plane() is before   # NOT installed globally
+        with m._tenant_scope():
+            assert _faults.active_plane() is m.faults
+        assert _faults.active_plane() is before
+    finally:
+        svc.close_session(m)
+        svc.stop()
+
+
+def test_reregistered_tenant_reuses_account_and_quota():
+    svc = ShuffleService(conf=ShuffleConf(slot_records=64,
+                                          tenant_host_bytes=1 << 20))
+    a1 = svc.register_tenant("t")
+    assert a1.quota.host_bytes == 1 << 20      # conf default applied
+    a2 = svc.register_tenant("t", quota=TenantQuota(host_bytes=1 << 10))
+    assert a2 is a1                            # idempotent registry
+    assert a1.quota.host_bytes == 1 << 10      # explicit quota rescopes
+    m = svc.open_session("t")
+    assert m.account is a1
+    svc.close_session(m)
+    # a fresh session after teardown re-installs the same account
+    m2 = svc.open_session("t")
+    assert m2.account is a1
+    svc.close_session(m2)
+    svc.stop()
