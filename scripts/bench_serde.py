@@ -17,9 +17,17 @@ real chip:
    a key-derived length), so decoded bytes are self-checking without a
    giant host-side reference.
 
+Between (1) and (2), COLUMNAR LEGS time the schema-aware v2 codec on
+the same wire-byte accounting: a fixed-width uint32/int64/float64
+schema (decode = column views over the row frame) and the same byte
+payloads under a bytes-only schema (bit-identical rows, offsets+heap
+input). Their rates print next to the v1 legs as
+``columnar_*_mbps``.
+
 Prints ONE JSON line with the device-side GB/s over ENCODED bytes (the
 wire format, what the fabric actually moves — same accounting as the
-reference's compressed-block GB/s).
+reference's compressed-block GB/s), then a second BENCH-style row
+(``serde_columnar_decode_gbps``) tracking the serde trajectory.
 
 Env: BENCH_RECORDS_PER_DEVICE (default 8M), BENCH_REPEATS (default 8).
 ``--journal PATH`` routes the run's exchange journal (spans + rollup
@@ -107,6 +115,75 @@ def main(argv=None) -> int:
         return 1
     del dec_keys, dec_payloads
 
+    # ---- columnar (v2) legs: the same wire-byte accounting as the v1
+    # legs so the encode_mbps/decode_mbps columns compare directly.
+    # Fixed-width leg: a 5-payload-word analytics-ish schema; decode is
+    # column VIEWS over the row frame (the whole point of v2).
+    from sparkrdma_tpu.api.serde import RowSchema, decode_cols, encode_cols
+
+    fsch = RowSchema([("a", "uint32"), ("b", "int64"), ("c", "float64")])
+    fcols = {"a": keys[:, 0].copy(),
+             "b": (keys[:, 0].astype(np.int64) << 16)
+             - keys[:, 1].astype(np.int64),
+             "c": (keys[:, 1].astype(np.float64) + 0.5) / 3.0}
+    # encode into a pre-touched out buffer, timing the SECOND pass: the
+    # pipeline encodes into REUSED pool-leased staging buffers, so the
+    # steady-state rate is the representative number (a cold first call
+    # is page-fault-bound on the fresh output pages, not codec-bound)
+    frows = np.empty((n, 2 + fsch.payload_words), dtype=np.uint32)
+    encode_cols(keys, fcols, fsch, out=frows)
+    t0 = time.perf_counter()
+    encode_cols(keys, fcols, fsch, out=frows)
+    col_fixed_encode_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fk, fdec = decode_cols(frows, 2, fsch)
+    # touch every decoded column so lazily-evaluated views cannot make
+    # the number a lie (sum forces a full read of each column)
+    sink = (int(fdec["a"].sum(dtype=np.uint64))
+            ^ int(fdec["b"].sum(dtype=np.int64)))
+    col_fixed_decode_s = time.perf_counter() - t0
+    ok = (np.array_equal(fk, keys)
+          and np.array_equal(fdec["b"][:4096], fcols["b"][:4096])
+          and np.array_equal(fdec["c"][:4096], fcols["c"][:4096])
+          and sink is not None)
+    if not ok:
+        print(json.dumps({"error": "columnar fixed round trip FAILED"}))
+        return 1
+    fixed_nbytes = frows.nbytes
+    del frows, fk, fdec, fcols
+
+    # Varlen leg: the SAME payloads as the v1 legs, under a bytes-only
+    # schema (bit-identical rows), fed in canonical offsets+heap form —
+    # the columnar contract for streaming pipelines.
+    from sparkrdma_tpu.api.serde import BytesColumn
+
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    heap = pat.reshape(-1)[
+        (np.arange(96)[None, :] < lens[:, None]).reshape(-1)]
+    vsch = RowSchema.bytes_only(MAX_PAYLOAD)
+    vrows = np.empty_like(rows)
+    encode_cols(keys, {"payload": BytesColumn(offsets, heap)}, vsch,
+                out=vrows)                       # warm the out pages
+    t0 = time.perf_counter()
+    encode_cols(keys, {"payload": BytesColumn(offsets, heap)}, vsch,
+                out=vrows)
+    col_var_encode_s = time.perf_counter() - t0
+    if not np.array_equal(vrows, rows):
+        print(json.dumps({"error": "columnar varlen rows differ from "
+                                   "v1 rows"}))
+        return 1
+    t0 = time.perf_counter()
+    vk, vdec = decode_cols(vrows, 2, vsch)
+    col_var_decode_s = time.perf_counter() - t0
+    bc = vdec["payload"]
+    if not (np.array_equal(vk, keys)
+            and np.array_equal(bc.heap[:4096], heap[:4096])
+            and bc[n // 2] == payloads[n // 2]):
+        print(json.dumps({"error": "columnar varlen round trip FAILED"}))
+        return 1
+    del vrows, vk, vdec, bc, pat
+
     conf = ShuffleConf(slot_records=max(4096, n), max_rounds=64,
                        max_slot_records=max(1 << 22, 2 * n),
                        val_words=w - 2, geometry_classes="fine",
@@ -150,9 +227,31 @@ def main(argv=None) -> int:
             "payload": "variable 0-92B, mean ~46B",
             "encode_mbps": round(n * w * 4 / encode_s / 1e6, 1),
             "decode_mbps": round(n * w * 4 / decode_s / 1e6, 1),
+            "columnar_fixed_encode_mbps": round(
+                fixed_nbytes / col_fixed_encode_s / 1e6, 1),
+            "columnar_fixed_decode_mbps": round(
+                fixed_nbytes / col_fixed_decode_s / 1e6, 1),
+            "columnar_varlen_encode_mbps": round(
+                n * w * 4 / col_var_encode_s / 1e6, 1),
+            "columnar_varlen_decode_mbps": round(
+                n * w * 4 / col_var_decode_s / 1e6, 1),
             "serde_native": native,
             "decoded_rows_verified": checked,
             "metrics": _bench_metrics(manager),
+        }))
+        # BENCH-style trajectory row for the serde series: the headline
+        # is the fixed-width columnar DECODE rate (the number ROADMAP
+        # item 2 tracks against the fabric GB/s), with the other legs
+        # riding as context columns.
+        print(json.dumps({
+            "metric": "serde_columnar_decode_gbps",
+            "value": round(fixed_nbytes / col_fixed_decode_s / 1e9, 3),
+            "unit": "GB/s",
+            "columnar_fixed_encode_gbps": round(
+                fixed_nbytes / col_fixed_encode_s / 1e9, 3),
+            "pickle_encode_gbps": round(n * w * 4 / encode_s / 1e9, 3),
+            "pickle_decode_gbps": round(n * w * 4 / decode_s / 1e9, 3),
+            "serde_native": native,
         }))
         return 0
     finally:

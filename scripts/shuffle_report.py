@@ -143,8 +143,11 @@ def aggregate(spans: List[dict]) -> dict:
     pool_high_water = 0
     spills = 0
     # serde codec totals are PROCESS-CUMULATIVE (schema v4): the true
-    # total is the max per process, summed across processes
-    serde_by_host: Dict[int, Tuple[float, float, float, float]] = {}
+    # total is the max per process, summed across processes.  Since v8
+    # the tuple also carries the columnar-v2 share (last 4 slots); the
+    # first 4 stay TOTALS across both codec paths, so pickle = total -
+    # columnar.
+    serde_by_host: Dict[int, Tuple[float, ...]] = {}
     # tiered-store totals are process-cumulative too (schema v6)
     store_by_host: Dict[int, Tuple[int, int, int, int]] = {}
     for s in spans:
@@ -166,7 +169,11 @@ def aggregate(spans: List[dict]) -> dict:
         cum = (float(s.get("serde_encode_bytes", 0) or 0),
                float(s.get("serde_encode_s", 0.0) or 0.0),
                float(s.get("serde_decode_bytes", 0) or 0),
-               float(s.get("serde_decode_s", 0.0) or 0.0))
+               float(s.get("serde_decode_s", 0.0) or 0.0),
+               float(s.get("serde_columnar_encode_bytes", 0) or 0),
+               float(s.get("serde_columnar_encode_s", 0.0) or 0.0),
+               float(s.get("serde_columnar_decode_bytes", 0) or 0),
+               float(s.get("serde_columnar_decode_s", 0.0) or 0.0))
         prev = serde_by_host.get(host)
         if prev is None or cum > prev:
             serde_by_host[host] = cum
@@ -219,19 +226,34 @@ def aggregate(spans: List[dict]) -> dict:
     enc_s = sum(v[1] for v in serde_by_host.values())
     dec_b = sum(v[2] for v in serde_by_host.values())
     dec_s = sum(v[3] for v in serde_by_host.values())
+    c_enc_b = sum(v[4] for v in serde_by_host.values() if len(v) > 4)
+    c_enc_s = sum(v[5] for v in serde_by_host.values() if len(v) > 4)
+    c_dec_b = sum(v[6] for v in serde_by_host.values() if len(v) > 4)
+    c_dec_s = sum(v[7] for v in serde_by_host.values() if len(v) > 4)
     exchange_s = phases["exchange_s"]
-    serde = {
-        "encode_bytes": int(enc_b),
-        "encode_s": round(enc_s, 6),
-        "encode_mbps": round(enc_b / enc_s / 1e6, 3) if enc_s > 0 else 0.0,
-        "decode_bytes": int(dec_b),
-        "decode_s": round(dec_s, 6),
-        "decode_mbps": round(dec_b / dec_s / 1e6, 3) if dec_s > 0 else 0.0,
-        # the fabric's delivered rate over the same journal — the number
-        # the host codec must beat for the path to be fabric-bound
-        "fabric_mbps": round(total_bytes / exchange_s / 1e6, 3)
-        if exchange_s > 0 else 0.0,
-    }
+
+    def _path(eb: float, es: float, db: float, ds: float) -> dict:
+        return {
+            "encode_bytes": int(eb),
+            "encode_s": round(es, 6),
+            "encode_mbps": round(eb / es / 1e6, 3) if es > 0 else 0.0,
+            "decode_bytes": int(db),
+            "decode_s": round(ds, 6),
+            "decode_mbps": round(db / ds / 1e6, 3) if ds > 0 else 0.0,
+        }
+
+    serde = _path(enc_b, enc_s, dec_b, dec_s)
+    # the fabric's delivered rate over the same journal — the number
+    # the host codec must beat for the path to be fabric-bound
+    serde["fabric_mbps"] = (round(total_bytes / exchange_s / 1e6, 3)
+                            if exchange_s > 0 else 0.0)
+    # per-codec-path split (schema v8): the legacy fields above are
+    # TOTALS across both paths, so the pickle share is the difference
+    serde["columnar"] = _path(c_enc_b, c_enc_s, c_dec_b, c_dec_s)
+    serde["pickle"] = _path(max(enc_b - c_enc_b, 0.0),
+                            max(enc_s - c_enc_s, 0.0),
+                            max(dec_b - c_dec_b, 0.0),
+                            max(dec_s - c_dec_s, 0.0))
     st_spill = sum(v[0] for v in store_by_host.values())
     st_fetch = sum(v[1] for v in store_by_host.values())
     st_hits = sum(v[2] for v in store_by_host.values())
@@ -556,16 +578,44 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             "inspect the journaled stall lines (queue occupancy, pool "
             "high-water) and the Perfetto trace (scripts/shuffle_trace.py)")
     serde = aggregate(spans).get("serde") or {} if spans else {}
-    verdict = _bound_verdict(serde)
-    if verdict.startswith("CODEC"):
+    fabric = serde.get("fabric_mbps", 0.0)
+    # the verdict is per CODEC PATH (schema v8): a run that mixes the
+    # columnar v2 codec with the v1 pickle-era fallback gets a verdict
+    # for each, so a fast columnar path cannot mask a slow fallback
+    for pname, advice in (
+            ("pickle", "enable the native codec (ShuffleConf("
+             "serde_native=True), build native/ with make) and raise "
+             "serde_threads; better yet declare a RowSchema — the "
+             "columnar v2 path decodes to views"),
+            ("columnar", "raise serde_threads and check that the native "
+             "library is built (sr_has_cols) — the numpy fallback is "
+             "bit-identical but slower")):
+        pd = serde.get(pname) or {}
+        verdict = _bound_verdict(pd, fabric=fabric)
+        if verdict.startswith("CODEC"):
+            codec = min(r for r in (pd["encode_mbps"], pd["decode_mbps"])
+                        if r > 0)
+            findings.append(
+                f"byte-payload path is codec-bound on the {pname} codec "
+                f"(host serde {codec:,.1f} MB/s vs fabric "
+                f"{fabric:,.1f} MB/s): {advice}; the timeline's "
+                "serde:encode/serde:h2d events show whether encode or "
+                "the host copy is the slow stage")
+    pk = serde.get("pickle") or {}
+    if pk.get("encode_bytes", 0) or pk.get("decode_bytes", 0):
+        share = serde.get("columnar") or {}
+        mixed = bool(share.get("encode_bytes", 0)
+                     or share.get("decode_bytes", 0))
         findings.append(
-            f"byte-payload path is codec-bound (host serde "
-            f"{min(r for r in (serde['encode_mbps'], serde['decode_mbps']) if r > 0):,.1f} MB/s "
-            f"vs fabric {serde['fabric_mbps']:,.1f} MB/s): enable the "
-            "native codec (ShuffleConf(serde_native=True), build "
-            "native/ with make) and raise serde_threads; the timeline's "
-            "serde:encode/serde:h2d events show whether encode or the "
-            "host copy is the slow stage")
+            ("part of the byte-payload serde work" if mixed else
+             "the byte-payload serde work") +
+            f" ({_fmt_bytes(pk.get('encode_bytes', 0) + pk.get('decode_bytes', 0))}) "
+            "ran on the schema-less v1 row codec: declare a RowSchema "
+            "(RowSchema.bytes_only(max_payload_bytes) for byte "
+            "payloads) at Dataset.from_host_payloads/from_host_rows so "
+            "the columnar v2 codec can encode with per-column memcpys "
+            "and decode to views — if a schema WAS declared, check the "
+            "degradation list below for serde_columnar")
     blocked = _sync_fetch_shuffles(spans)
     if blocked:
         total = sum(blocked.values())
@@ -600,6 +650,11 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             "serde_native": "native codec failed; running on the "
                             "bit-identical numpy path (slower) — rebuild "
                             "native/ and check its logs",
+            "serde_columnar": "columnar v2 codec failed; byte payloads "
+                              "fell back to the bit-identical v1 row "
+                              "codec (no views, slower decode) — check "
+                              "the schema against the workload and "
+                              "rebuild native/",
             "transport": "configured transport failed to construct; "
                          "running on the plain xla all_to_all — check "
                          "the ring/hierarchical prerequisites",
@@ -625,12 +680,18 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
     return findings
 
 
-def _bound_verdict(sd: dict) -> str:
+def _bound_verdict(sd: dict, fabric: Optional[float] = None) -> str:
     """Which side of the host<->device boundary limits the byte-payload
-    path: the slower codec direction vs. the fabric's delivered rate."""
+    path: the slower codec direction vs. the fabric's delivered rate.
+
+    ``sd`` may be the whole serde section or one of its per-codec-path
+    sub-dicts (``columnar`` / ``pickle``); the sub-dicts carry no
+    ``fabric_mbps`` of their own, so callers pass the shared fabric
+    rate explicitly."""
     rates = [r for r in (sd.get("encode_mbps", 0.0),
                          sd.get("decode_mbps", 0.0)) if r > 0]
-    fabric = sd.get("fabric_mbps", 0.0)
+    if fabric is None:
+        fabric = sd.get("fabric_mbps", 0.0)
     if not rates or fabric <= 0:
         return "insufficient data"
     codec = min(rates)
@@ -677,6 +738,18 @@ def print_report(rep: dict, top: int) -> None:
               f"{sd['encode_s']:.4f}s  ({sd['encode_mbps']:,.1f} MB/s)")
         print(f"  decode: {_fmt_bytes(sd['decode_bytes'])} in "
               f"{sd['decode_s']:.4f}s  ({sd['decode_mbps']:,.1f} MB/s)")
+        fabric = sd.get("fabric_mbps", 0.0)
+        # per-codec-path split with its OWN verdict: a mixed run shows
+        # which path (columnar v2 vs pickle-era v1) limits the pipeline
+        for pname in ("columnar", "pickle"):
+            pd = sd.get(pname) or {}
+            if not (pd.get("encode_bytes") or pd.get("decode_bytes")):
+                continue
+            print(f"  {pname:<8} encode {_fmt_bytes(pd['encode_bytes'])} "
+                  f"({pd['encode_mbps']:,.1f} MB/s)  "
+                  f"decode {_fmt_bytes(pd['decode_bytes'])} "
+                  f"({pd['decode_mbps']:,.1f} MB/s)  "
+                  f"[{_bound_verdict(pd, fabric=fabric)}]")
         print(f"  fabric delivered rate over the same spans: "
               f"{sd['fabric_mbps']:,.1f} MB/s "
               f"({_bound_verdict(sd)})")

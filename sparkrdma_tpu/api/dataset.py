@@ -279,7 +279,7 @@ class Dataset:
     """A distributed batch of fixed-width records with Spark-ish verbs."""
 
     def __init__(self, manager: ShuffleManager, records: jax.Array,
-                 totals: Optional[jax.Array] = None):
+                 totals: Optional[jax.Array] = None, schema=None):
         self.manager = manager
         self.records = records          # columnar [W, mesh * cap]
         mesh = manager.runtime.num_partitions
@@ -287,32 +287,46 @@ class Dataset:
             per = records.shape[1] // mesh
             totals = jnp.full((mesh,), per, jnp.int32)
         self.totals = totals
+        #: optional RowSchema describing the payload-word layout —
+        #: carried through layout-preserving verbs so decode can return
+        #: columnar views instead of per-row pickle materialization
+        self.schema = schema
 
     # ------------------------------------------------------------------
     @classmethod
     def from_host_rows(cls, manager: ShuffleManager,
-                       rows: np.ndarray) -> "Dataset":
+                       rows: np.ndarray, schema=None) -> "Dataset":
         """Rows ``[N, W]`` -> device Dataset (N divisible by mesh).
 
         Rejects rows carrying the RESERVED all-ones key (see module
         docstring): such rows would be silently dropped by
         ``to_host_rows``/``count``/``join`` later — fail loudly at the
-        boundary instead.
+        boundary instead. ``schema`` optionally declares the payload
+        layout of the (already encoded) rows so the decode side can use
+        the columnar view path.
         """
         kw = manager.conf.key_words
         rows = np.asarray(rows)
+        if schema is not None and \
+                schema.payload_words != manager.conf.val_words:
+            raise ValueError(
+                f"schema declares {schema.payload_words} payload words "
+                f"but the manager was configured with "
+                f"val_words={manager.conf.val_words}")
         if rows.size and bool((rows[:, :kw] == _NULL).all(axis=1).any()):
             raise ValueError(
                 "input rows use the reserved all-ones (0xFFFFFFFF) key, "
                 "which this layer reserves for padding filler — remap "
                 "that key before loading")
-        return cls(manager, manager.runtime.shard_records(rows))
+        return cls(manager, manager.runtime.shard_records(rows),
+                   schema=schema)
 
     @classmethod
     def from_host_payloads(cls, manager: ShuffleManager, keys: np.ndarray,
                            payloads, max_payload_bytes: int, *,
                            chunk_records: Optional[int] = None,
-                           overlap: bool = True) -> "Dataset":
+                           overlap: bool = True,
+                           schema=None) -> "Dataset":
         """Byte payloads -> device Dataset via the pipelined serde path.
 
         ``keys`` is ``[N, key_words]`` uint32 (``N`` divisible by mesh),
@@ -322,8 +336,18 @@ class Dataset:
         ``api/pipeline.py``. The record geometry must match the
         manager's exchange config: ``payload_words(max_payload_bytes)``
         must equal ``conf.val_words`` so the loaded rows are exchangeable.
+
+        Passing a bytes-only :class:`~sparkrdma_tpu.api.serde.RowSchema`
+        (``RowSchema.bytes_only(max_payload_bytes)`` or equivalent)
+        switches the load to the COLUMNAR codec — bit-identical rows,
+        wide memcpys instead of per-row object walking — and marks the
+        dataset so :meth:`to_host_payloads` can decode via column views
+        with zero per-row materialization. Any columnar failure that is
+        not a data error degrades stickily to the v1 codec
+        (``serde_columnar`` rung of the degradation ladder).
         """
-        from sparkrdma_tpu.api.pipeline import encode_rows_to_device
+        from sparkrdma_tpu.api.pipeline import (encode_cols_to_device,
+                                                encode_rows_to_device)
         from sparkrdma_tpu.api.serde import payload_words
 
         conf = manager.conf
@@ -334,6 +358,15 @@ class Dataset:
                 f"val_words={pw} but the manager was configured with "
                 f"val_words={conf.val_words} — size the ShuffleConf with "
                 f"payload_words(max_payload_bytes)")
+        if schema is not None:
+            if not schema.is_bytes_only:
+                raise ValueError(
+                    "from_host_payloads takes a bytes-only schema "
+                    "(use from_host_columns for multi-column schemas)")
+            if schema.var_max_bytes != max_payload_bytes:
+                raise ValueError(
+                    f"schema bytes column caps {schema.var_max_bytes} "
+                    f"bytes but max_payload_bytes={max_payload_bytes}")
         keys = np.asarray(keys)
         if keys.ndim == 2 and keys.size and \
                 bool((keys == _NULL).all(axis=1).any()):
@@ -341,19 +374,110 @@ class Dataset:
                 "input keys use the reserved all-ones (0xFFFFFFFF) key, "
                 "which this layer reserves for padding filler — remap "
                 "that key before loading")
+        if schema is not None and cls._columnar_ok(conf):
+            from sparkrdma_tpu.api.serde import _degrade_columnar
+            try:
+                records = encode_cols_to_device(
+                    manager, keys, {schema.var_name: payloads}, schema,
+                    chunk_records=chunk_records, overlap=overlap)
+                return cls(manager, records, schema=schema)
+            except ValueError:
+                raise  # data-error contract (oversize / non-bytes row)
+            except Exception as exc:
+                _degrade_columnar("encode", exc)
         records = encode_rows_to_device(
             manager, keys, payloads, max_payload_bytes,
             chunk_records=chunk_records, overlap=overlap)
-        return cls(manager, records)
+        return cls(manager, records, schema=schema)
+
+    @classmethod
+    def from_host_columns(cls, manager: ShuffleManager, keys: np.ndarray,
+                          columns, schema, *,
+                          chunk_records: Optional[int] = None,
+                          overlap: bool = True) -> "Dataset":
+        """Named host columns -> device Dataset under a
+        :class:`~sparkrdma_tpu.api.serde.RowSchema` (the schema-aware
+        twin of :meth:`from_host_payloads`). ``columns`` maps every
+        schema column name to its values; ``schema.payload_words`` must
+        equal ``conf.val_words``. Encode is wide per-column memcpys
+        overlapped with the H2D transfer; a native-codec failure falls
+        back to the bit-identical numpy columnar path."""
+        from sparkrdma_tpu.api.pipeline import encode_cols_to_device
+
+        conf = manager.conf
+        if schema.payload_words != conf.val_words:
+            raise ValueError(
+                f"schema declares {schema.payload_words} payload words "
+                f"but the manager was configured with "
+                f"val_words={conf.val_words}")
+        keys = np.asarray(keys)
+        if keys.ndim == 2 and keys.size and \
+                bool((keys == _NULL).all(axis=1).any()):
+            raise ValueError(
+                "input keys use the reserved all-ones (0xFFFFFFFF) key, "
+                "which this layer reserves for padding filler — remap "
+                "that key before loading")
+        records = encode_cols_to_device(
+            manager, keys, columns, schema,
+            chunk_records=chunk_records, overlap=overlap)
+        return cls(manager, records, schema=schema)
+
+    @staticmethod
+    def _columnar_ok(conf) -> bool:
+        """True when the schema path may use the columnar codec: knob
+        on, not stickily degraded."""
+        from sparkrdma_tpu.api.serde import columnar_enabled
+
+        return conf.serde_schema_columnar and columnar_enabled()
 
     def to_host_payloads(self, *, overlap: bool = True):
         """Inverse of :meth:`from_host_payloads`: ``(keys [N, kw] uint32,
-        payloads list[bytes])`` with filler rows dropped, decoding each
-        device window while the next window's D2H copy is in flight."""
-        from sparkrdma_tpu.api.pipeline import decode_rows_from_device
+        payloads)`` with filler rows dropped, decoding each device
+        window while the next window's D2H copy is in flight.
 
+        When the dataset carries a bytes-only schema, the payloads come
+        back as a lazy :class:`~sparkrdma_tpu.api.serde.BytesColumn`
+        (offsets + heap views, rows materialize only on access) instead
+        of a list of bytes — no ``pickle.loads`` at all, so a decode ->
+        re-encode round trip never builds a Python object per row. It
+        compares and iterates like a list of bytes."""
+        from sparkrdma_tpu.api.pipeline import (decode_cols_from_device,
+                                                decode_rows_from_device)
+
+        sch = self.schema
+        if (sch is not None and sch.is_bytes_only
+                and self._columnar_ok(self.manager.conf)):
+            from sparkrdma_tpu.api.serde import _degrade_columnar
+            try:
+                keys, cols = decode_cols_from_device(
+                    self.manager, self.records, self.totals, sch,
+                    overlap=overlap)
+                return keys, cols[sch.var_name]
+            except ValueError:
+                raise  # data-error contract (corrupt length word)
+            except Exception as exc:
+                _degrade_columnar("decode", exc)
         return decode_rows_from_device(self.manager, self.records,
                                        self.totals, overlap=overlap)
+
+    def to_host_columns(self, *, overlap: bool = True):
+        """Decode the dataset through its schema: ``(keys [N, kw]
+        uint32, {name: column})`` with filler rows dropped. Fixed-width
+        columns are numpy VIEWS over the fetched windows (zero per-row
+        materialization); the varlen column is a
+        :class:`~sparkrdma_tpu.api.serde.BytesColumn`. Requires a
+        schema (declared at load time or attached via
+        :meth:`from_host_rows`)."""
+        from sparkrdma_tpu.api.pipeline import decode_cols_from_device
+
+        if self.schema is None:
+            raise ValueError(
+                "to_host_columns needs a schema-carrying dataset — "
+                "declare a RowSchema at from_host_columns/"
+                "from_host_payloads time")
+        return decode_cols_from_device(self.manager, self.records,
+                                       self.totals, self.schema,
+                                       overlap=overlap)
 
     def to_host_rows(self) -> np.ndarray:
         """Valid records only, concatenated in device order (reserved
@@ -428,7 +552,12 @@ class Dataset:
                 handle, key_ordering=key_ordering, aggregator=aggregator,
                 float_payload=float_payload).read()
             # detach from the pool before unregister releases the buffer
-            return Dataset(m, jnp.array(out), jnp.array(totals))
+            # (schema survives layout-preserving exchanges; an
+            # aggregator rewrites payload words, so the layout claim no
+            # longer holds and the schema is dropped)
+            return Dataset(m, jnp.array(out), jnp.array(totals),
+                           schema=self.schema if aggregator is None
+                           else None)
         finally:
             m.unregister_shuffle(sid)
 
@@ -509,7 +638,7 @@ class Dataset:
         samples = np.asarray(jax.device_get(sampler(records)))
         splitters = compute_splitters(samples, rt.num_partitions)
         part = range_partitioner(splitters, m.conf.key_words)
-        ds = Dataset(m, records)
+        ds = Dataset(m, records, schema=self.schema)
         return ds._exchange(part, rt.num_partitions, key_ordering=True)
 
     def reduce_by_key(self, op: str = "sum",
@@ -572,7 +701,7 @@ class Dataset:
             ))
             cache[ck] = fn
         out, totals = fn(a.records, a.totals)
-        return Dataset(m, out, jnp.array(totals))
+        return Dataset(m, out, jnp.array(totals), schema=self.schema)
 
     def count_by_key(self) -> "Dataset":
         """Per-key record counts (rdd.countByKey): rows become

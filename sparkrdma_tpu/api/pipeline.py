@@ -45,7 +45,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparkrdma_tpu.api.serde import (decode_bytes_rows, encode_bytes_rows,
+from sparkrdma_tpu.api.serde import (_FIXED_KINDS, BytesColumn, RowSchema,
+                                     _canon_varlen, _coerce_fixed,
+                                     decode_bytes_rows, decode_cols,
+                                     encode_bytes_rows, encode_cols,
                                      payload_words)
 from sparkrdma_tpu.obs.timeline import record_active
 
@@ -292,5 +295,242 @@ def decode_rows_from_device(manager, records: jax.Array,
     return np.concatenate(keys_parts), payloads
 
 
+# ---------------------------------------------------------------------
+# Columnar (schema-aware) twins: same chunking, same placement
+# equivalence, but the per-chunk gather is ARRAY SLICING instead of
+# Python-list slicing — columns are canonicalized once up front (fixed
+# columns to contiguous arrays, the varlen column to offsets + heap), so
+# a chunk gather never touches a per-row Python object.
+# ---------------------------------------------------------------------
+
+def _canon_columns(schema: RowSchema, columns, n: int):
+    """Normalize every column once: ``(fixed, offsets, heap)`` where
+    ``fixed`` is ``[(name, kind, word_off, contiguous array)]``."""
+    missing = set(schema.names) - set(columns)
+    extra = set(columns) - set(schema.names)
+    if missing or extra:
+        raise ValueError(
+            f"columns do not match schema: missing {sorted(missing)}, "
+            f"unexpected {sorted(extra)}")
+    fixed = [(fname, fkind, foff,
+              _coerce_fixed(fname, fkind, columns[fname], n))
+             for fname, fkind, foff in schema.fixed]
+    offsets = heap = None
+    if schema.var_name is not None:
+        offsets, heap = _canon_varlen(columns[schema.var_name], n)
+    return fixed, offsets, heap
+
+
+def _gather_col_chunk(fixed, offsets, heap, schema: RowSchema,
+                      per: int, lo: int, hi: int, mesh: int) -> dict:
+    """Columnar :func:`_gather_chunk`: rows ``lo:hi`` of every device's
+    contiguous range, as a columns dict ready for ``encode_cols``."""
+    ranges = [(d * per + lo, d * per + hi) for d in range(mesh)]
+    cols: dict = {}
+    for fname, _, _, arr in fixed:
+        cols[fname] = np.concatenate([arr[a:b] for a, b in ranges])
+    if schema.var_name is not None:
+        lens = np.concatenate([np.diff(offsets[a:b + 1])
+                               for a, b in ranges])
+        coff = np.zeros(lens.size + 1, dtype=np.int64)
+        np.cumsum(lens, out=coff[1:])
+        parts = [heap[int(offsets[a]):int(offsets[b])]
+                 for a, b in ranges]
+        cheap = (np.concatenate(parts) if int(coff[-1])
+                 else np.zeros(0, np.uint8))
+        cols[schema.var_name] = BytesColumn(coff, cheap)
+    return cols
+
+
+def encode_cols_to_device(manager, keys: np.ndarray, columns,
+                          schema: RowSchema, *,
+                          chunk_records: Optional[int] = None,
+                          overlap: bool = True) -> jax.Array:
+    """Schema-aware :func:`encode_rows_to_device`: encode named columns
+    into uint32 rows under ``schema`` and shard them onto the mesh,
+    overlapping host encode with H2D transfer. Placement-equivalent to
+    the single-shot ``encode_cols -> shard_records`` path."""
+    conf = manager.conf
+    rt = manager.runtime
+    mesh = rt.num_partitions
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint32))
+    if keys.ndim == 1:
+        keys = keys[:, None]
+    n = keys.shape[0]
+    native = conf.serde_native
+    threads = conf.serde_threads or None
+    fixed, offsets, heap = _canon_columns(schema, columns, n)
+    canon = {fname: arr for fname, _, _, arr in fixed}
+    if schema.var_name is not None:
+        canon[schema.var_name] = BytesColumn(offsets, heap)
+    chunk = _chunk_rows(conf, n, mesh, chunk_records)
+    if chunk == 0 or n <= chunk or n % mesh != 0:
+        rows = encode_cols(keys, canon, schema,
+                           native=native, threads=threads)
+        return rt.shard_records(rows)
+
+    per = n // mesh
+    cc = chunk // mesh
+    bounds = [(lo, min(per, lo + cc)) for lo in range(0, per, cc)]
+    w = keys.shape[1] + schema.payload_words
+    pool = staging_pool()
+
+    def encode_chunk(ci: int, lo: int, hi: int):
+        c = (hi - lo) * mesh
+        buf = pool.get(c * w * 4)
+        out = buf.view(np.uint32, (c, w))
+        ck = np.concatenate([keys[d * per + lo: d * per + hi]
+                             for d in range(mesh)])
+        ccols = _gather_col_chunk(fixed, offsets, heap, schema,
+                                  per, lo, hi, mesh)
+        record_active("serde:encode", ph="B", chunk=ci, rows=c)
+        encode_cols(np.ascontiguousarray(ck), ccols, schema,
+                    native=native, threads=threads, out=out)
+        record_active("serde:encode", ph="E", chunk=ci)
+        return buf, out
+
+    def transfer(ci: int, buf, out) -> jax.Array:
+        record_active("serde:h2d", ph="B", chunk=ci, rows=out.shape[0])
+        arr = rt.shard_records(out)
+        buf.release()
+        record_active("serde:h2d", ph="E", chunk=ci)
+        return arr
+
+    chunks: List[jax.Array] = []
+    if not overlap:
+        for ci, (lo, hi) in enumerate(bounds):
+            buf, out = encode_chunk(ci, lo, hi)
+            chunks.append(transfer(ci, buf, out))
+        return _assemble(rt, chunks)
+
+    q: Queue = Queue(maxsize=_QUEUE_DEPTH)
+
+    def producer():
+        try:
+            for ci, (lo, hi) in enumerate(bounds):
+                q.put((ci,) + encode_chunk(ci, lo, hi))
+            q.put(None)
+        except BaseException as e:  # surfaced on the consumer side
+            q.put(e)
+
+    t = threading.Thread(target=producer, name="serde-encode",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                item = q.get(timeout=30.0)
+            except Empty:
+                if not t.is_alive():
+                    raise RuntimeError(
+                        "serde-encode producer died without a result")
+                continue
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            ci, buf, out = item
+            chunks.append(transfer(ci, buf, out))
+    finally:
+        t.join()
+    return _assemble(rt, chunks)
+
+
+def _merge_col_parts(schema: RowSchema, parts: List[dict]) -> dict:
+    """Concatenate per-shard column dicts in device order. A single
+    part passes through untouched, preserving the decode VIEWS."""
+    if len(parts) == 1:
+        return parts[0]
+    cols: dict = {}
+    for fname, _, _ in schema.fixed:
+        cols[fname] = np.concatenate([p[fname] for p in parts])
+    if schema.var_name is not None:
+        bcs = [p[schema.var_name] for p in parts]
+        lens = np.concatenate([np.diff(bc.offsets) for bc in bcs])
+        offsets = np.zeros(lens.size + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        heaps = [bc.heap[int(bc.offsets[0]):int(bc.offsets[-1])]
+                 for bc in bcs]
+        heap = (np.concatenate(heaps) if int(offsets[-1])
+                else np.zeros(0, np.uint8))
+        cols[schema.var_name] = BytesColumn(offsets, heap)
+    return cols
+
+
+def decode_cols_from_device(manager, records: jax.Array, totals,
+                            schema: RowSchema, *, overlap: bool = True
+                            ) -> Tuple[np.ndarray, dict]:
+    """Schema-aware :func:`decode_rows_from_device`: device batch ->
+    host ``(keys, {name: column})`` with fixed-width columns decoded as
+    numpy VIEWS over each fetched window (one ``ascontiguousarray``
+    copy per window to fix the transpose strides — same as the v1 path
+    — then zero per-row work)."""
+    conf = manager.conf
+    kw = conf.key_words
+    mesh = manager.runtime.num_partitions
+    cap = records.shape[1] // mesh
+    empty_cols = {fname: np.zeros(0, _FIXED_KINDS[fkind][1])
+                  for fname, fkind, _ in schema.fixed}
+    if schema.var_name is not None:
+        empty_cols[schema.var_name] = BytesColumn(
+            np.zeros(1, np.int64), np.zeros(0, np.uint8))
+    if cap == 0:
+        return np.empty((0, kw), np.uint32), empty_cols
+    tot = np.asarray(totals)
+    native = conf.serde_native
+    threads = conf.serde_threads or None
+    shards = sorted(records.addressable_shards,
+                    key=lambda s: s.index[1].start)
+
+    def fetch(i: int) -> Tuple[int, np.ndarray]:
+        s = shards[i]
+        d = s.index[1].start // cap
+        record_active("serde:d2h", ph="B", device=d)
+        a = np.asarray(s.data)
+        record_active("serde:d2h", ph="E", device=d)
+        return d, a
+
+    def decode(d: int, cols: np.ndarray):
+        rows = cols[:, : int(tot[d])].T
+        if rows.size:
+            filler = (rows[:, :kw] == _NULL).all(axis=1)
+            if filler.any():
+                rows = rows[~filler]
+        record_active("serde:decode", ph="B", device=d,
+                      rows=int(rows.shape[0]))
+        out = decode_cols(np.ascontiguousarray(rows), kw, schema,
+                          native=native, threads=threads)
+        record_active("serde:decode", ph="E", device=d)
+        return out
+
+    keys_parts: List[np.ndarray] = []
+    col_parts: List[dict] = []
+
+    def consume(part):
+        k, c = part
+        keys_parts.append(k)
+        col_parts.append(c)
+
+    if not overlap or len(shards) <= 1:
+        for i in range(len(shards)):
+            consume(decode(*fetch(i)))
+    else:
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="serde-d2h") as ex:
+            nxt = ex.submit(fetch, 0)
+            for i in range(len(shards)):
+                d, cols = nxt.result()
+                if i + 1 < len(shards):
+                    nxt = ex.submit(fetch, i + 1)
+                consume(decode(d, cols))
+
+    if not keys_parts:
+        return np.empty((0, kw), np.uint32), empty_cols
+    keys = (keys_parts[0] if len(keys_parts) == 1
+            else np.concatenate(keys_parts))
+    return keys, _merge_col_parts(schema, col_parts)
+
+
 __all__ = ["encode_rows_to_device", "decode_rows_from_device",
+           "encode_cols_to_device", "decode_cols_from_device",
            "staging_pool"]

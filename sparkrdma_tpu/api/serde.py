@@ -50,6 +50,28 @@ Both paths feed the process-wide metrics registry
 (``serde.encode_bytes`` / ``serde.decode_bytes`` / ``…_ns`` counters);
 the SPI layer folds the cumulative totals into each exchange span so
 ``shuffle_report.py`` can say whether a byte-payload job is codec-bound.
+
+**Columnar v2 (schema-aware, this file's second half).** The padded-slot
+scheme above is the schema-LESS path: every record is an opaque byte
+payload, and decode must materialize a Python ``bytes`` object per row.
+When the caller can declare a :class:`RowSchema` (fixed-width
+uint32/int64/float64 columns plus at most one trailing varlen-bytes
+column backed by an offsets array and a byte heap, Arrow-style), the
+same word-value wire format admits a much cheaper codec:
+:func:`encode_cols` reduces to wide per-column stores (native:
+``sr_encode_cols`` sharded over the same GIL-released thread pool;
+numpy fallback: vectorized column assignments), and :func:`decode_cols`
+returns **numpy column views over the receive buffer** — zero per-row
+materialization, no pickle at all for fixed-width schemas. A schema
+whose only column is a bytes column lays out rows BIT-IDENTICAL to the
+v1 padded-slot format, which is what makes the degradation ladder
+honest: any columnar construction/validation failure falls stickily to
+the v1 codec (``_degrade_columnar`` → ``serde_columnar`` rung) with
+byte-identical rows, while native failures INSIDE the columnar codec
+fall to its bit-identical numpy fallback via the existing
+``_degrade_native`` rung. Columnar calls feed ``serde.columnar.*``
+counters; :func:`codec_totals` reports both the per-path and the
+combined totals.
 """
 
 from __future__ import annotations
@@ -196,7 +218,13 @@ def codec_totals() -> dict:
     """Cumulative process-wide codec totals (journal field source).
 
     Byte counts are ENCODED bytes (the wire format — same accounting as
-    the fabric GB/s), seconds are host wall-clock inside the codec."""
+    the fabric GB/s), seconds are host wall-clock inside the codec.
+    The legacy ``serde_{encode,decode}_*`` keys are TOTALS ACROSS BOTH
+    codec paths (v1 pickle + columnar) so downstream consumers — the
+    rollup's ``serde_*_mbps`` series especially — keep meaning "all
+    host serde work"; the ``serde_columnar_*`` keys carry the columnar
+    share so the report can split the verdict by path (pickle share =
+    total − columnar)."""
     from sparkrdma_tpu.obs.metrics import global_registry
 
     reg = global_registry()
@@ -204,11 +232,19 @@ def codec_totals() -> dict:
     def _c(name: str) -> int:
         return int(reg.counter(name).value)
 
+    ceb = _c("serde.columnar.encode_bytes")
+    cen = _c("serde.columnar.encode_ns")
+    cdb = _c("serde.columnar.decode_bytes")
+    cdn = _c("serde.columnar.decode_ns")
     return {
-        "serde_encode_bytes": _c("serde.encode_bytes"),
-        "serde_encode_s": _c("serde.encode_ns") / 1e9,
-        "serde_decode_bytes": _c("serde.decode_bytes"),
-        "serde_decode_s": _c("serde.decode_ns") / 1e9,
+        "serde_encode_bytes": _c("serde.encode_bytes") + ceb,
+        "serde_encode_s": (_c("serde.encode_ns") + cen) / 1e9,
+        "serde_decode_bytes": _c("serde.decode_bytes") + cdb,
+        "serde_decode_s": (_c("serde.decode_ns") + cdn) / 1e9,
+        "serde_columnar_encode_bytes": ceb,
+        "serde_columnar_encode_s": cen / 1e9,
+        "serde_columnar_decode_bytes": cdb,
+        "serde_columnar_decode_s": cdn / 1e9,
     }
 
 
@@ -422,5 +458,493 @@ def decode_bytes_rows(
     return keys, payloads
 
 
+# ---------------------------------------------------------------------
+# Columnar v2: schema-aware layout, view-returning decode
+# ---------------------------------------------------------------------
+
+#: words per fixed-width column kind (the wire format is word-VALUES:
+#: an int64/float64 is two adjacent words, lo then hi, where the
+#: uint64 bit pattern is ``lo | hi << 32`` — on little-endian hosts
+#: that is exactly the in-memory layout, so native memcpys and numpy
+#: views agree; big-endian hosts go through explicit lo/hi arithmetic)
+_FIXED_KINDS = {
+    "uint32": (1, np.dtype(np.uint32)),
+    "int64": (2, np.dtype(np.int64)),
+    "float64": (2, np.dtype(np.float64)),
+}
+
+# Columnar rung of the degradation ladder (below the native rung): a
+# non-data-error failure while CONSTRUCTING or VALIDATING a columnar
+# frame falls the schema path back to the v1 codec — legal because a
+# bytes-only schema's rows are bit-identical to v1 rows, so callers see
+# identical outputs, just slower. Sticky per process, same rationale as
+# _native_disabled. Data errors (ValueError) re-raise unchanged.
+_columnar_disabled: bool = False
+_columnar_disabled_reason: str = ""
+
+
+def _degrade_columnar(op: str, exc: BaseException) -> None:
+    global _columnar_disabled, _columnar_disabled_reason
+    if not _columnar_disabled:
+        _columnar_disabled = True
+        _columnar_disabled_reason = f"{op}: {exc}"
+        from sparkrdma_tpu import faults as _faults
+
+        _faults.note_degradation("serde_columnar",
+                                 reason=_columnar_disabled_reason)
+
+
+def _reset_columnar_degrade() -> None:
+    """Test hook: re-arm the columnar codec after a sticky degradation."""
+    global _columnar_disabled, _columnar_disabled_reason
+    _columnar_disabled = False
+    _columnar_disabled_reason = ""
+
+
+def columnar_enabled() -> bool:
+    """True when the schema path may dispatch to the columnar codec
+    (not stickily degraded). Callers additionally gate on
+    ``ShuffleConf.serde_schema_columnar``."""
+    return not _columnar_disabled
+
+
+class RowSchema:
+    """Declared column layout of a record's payload region.
+
+    ``fields`` is an ordered sequence of ``(name, kind)`` pairs where
+    ``kind`` is ``"uint32"`` (1 word), ``"int64"`` / ``"float64"``
+    (2 words, lo/hi word-value encoding), or ``("bytes", max_len)`` —
+    a varlen bytes column stored exactly like a v1 padded slot
+    (1 length word + ``ceil(max_len / 4)`` zero-padded words). At most
+    one bytes column, and it must be LAST (the Arrow-style tail heap);
+    ``"keys"`` is reserved (the key words live outside the payload
+    region). Schemas are immutable value objects: equality is field
+    equality, and :attr:`payload_words` must match the dataset's
+    ``conf.val_words`` the same way ``payload_words(max_payload_bytes)``
+    must for the v1 codec.
+    """
+
+    __slots__ = ("fields", "names", "payload_words", "fixed",
+                 "var_name", "var_max_bytes", "var_len_word",
+                 "var_slot_words")
+
+    def __init__(self, fields: Sequence[Tuple[str, object]]):
+        norm: List[Tuple[str, object]] = []
+        fixed: List[Tuple[str, str, int]] = []   # (name, kind, word off)
+        seen = set()
+        var_name: Optional[str] = None
+        var_max = 0
+        var_lw = -1
+        off = 0
+        for f in fields:
+            try:
+                name, kind = f
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"schema field {f!r} is not a (name, kind) pair")
+            if not isinstance(name, str) or not name:
+                raise ValueError(
+                    f"schema column name {name!r} must be a non-empty str")
+            if name == "keys":
+                raise ValueError(
+                    'schema column name "keys" is reserved — key words '
+                    "live outside the payload region")
+            if name in seen:
+                raise ValueError(f"duplicate schema column {name!r}")
+            if var_name is not None:
+                raise ValueError(
+                    f"bytes column {var_name!r} must be the LAST schema "
+                    f"column (found {name!r} after it)")
+            seen.add(name)
+            if isinstance(kind, str) and kind in _FIXED_KINDS:
+                fixed.append((name, kind, off))
+                off += _FIXED_KINDS[kind][0]
+                norm.append((name, kind))
+            else:
+                try:
+                    tag, max_len = kind
+                except (TypeError, ValueError):
+                    tag = None
+                if tag != "bytes":
+                    raise ValueError(
+                        f"schema column {name!r} has unknown kind "
+                        f"{kind!r} — expected 'uint32', 'int64', "
+                        "'float64', or ('bytes', max_len)")
+                max_len = int(max_len)
+                if max_len < 0:
+                    raise ValueError(
+                        f"bytes column {name!r}: max_len must be >= 0")
+                var_name, var_max, var_lw = name, max_len, off
+                off += 1 + (max_len + 3) // 4
+                norm.append((name, ("bytes", max_len)))
+        if not norm:
+            raise ValueError("schema needs at least one column")
+        self.fields = tuple(norm)
+        self.names = tuple(n for n, _ in norm)
+        self.fixed = tuple(fixed)
+        self.var_name = var_name
+        self.var_max_bytes = var_max
+        self.var_len_word = var_lw
+        self.var_slot_words = (var_max + 3) // 4 if var_name else 0
+        self.payload_words = off
+
+    @classmethod
+    def bytes_only(cls, max_payload_bytes: int,
+                   name: str = "payload") -> "RowSchema":
+        """The schema whose rows are bit-identical to the v1 codec's:
+        one varlen bytes column sized like ``payload_words``."""
+        return cls([(name, ("bytes", max_payload_bytes))])
+
+    @property
+    def is_bytes_only(self) -> bool:
+        return len(self.fields) == 1 and self.var_name is not None
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, RowSchema) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+    def __repr__(self) -> str:
+        return f"RowSchema({list(self.fields)!r})"
+
+
+class BytesColumn:
+    """A decoded varlen-bytes column: ``offsets`` (int64[N + 1]) into a
+    contiguous uint8 ``heap`` — Arrow's variable-binary layout. Behaves
+    as a lazy sequence of ``bytes`` (rows materialize only on
+    ``[]``/iteration), and :func:`encode_cols` consumes the offsets +
+    heap directly, so a decode → re-encode round trip never builds a
+    Python object per row."""
+
+    __slots__ = ("offsets", "heap")
+
+    def __init__(self, offsets: np.ndarray, heap: np.ndarray):
+        self.offsets = offsets
+        self.heap = heap
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"row {i} out of range for {n} rows")
+        return self.heap[self.offsets[i]:self.offsets[i + 1]].tobytes()
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def to_list(self) -> List[bytes]:
+        return list(self)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, BytesColumn):
+            a0, a1 = int(self.offsets[0]), int(self.offsets[-1])
+            b0, b1 = int(other.offsets[0]), int(other.offsets[-1])
+            return (np.array_equal(self.offsets - a0,
+                                   other.offsets - b0)
+                    and np.array_equal(self.heap[a0:a1],
+                                       other.heap[b0:b1]))
+        if isinstance(other, (list, tuple)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other))
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return (f"BytesColumn(rows={len(self)}, "
+                f"heap_bytes={int(self.offsets[-1] - self.offsets[0])})")
+
+
+def _canon_varlen(values, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Normalize a varlen column to ``(offsets int64[n + 1], heap
+    uint8[])``. Accepts a :class:`BytesColumn`, an ``(offsets, heap)``
+    pair, or any sequence of bytes-like rows (one join, same cost as the
+    v1 encoder's)."""
+    if isinstance(values, BytesColumn):
+        offsets, heap = values.offsets, values.heap
+    elif (isinstance(values, tuple) and len(values) == 2
+          and isinstance(values[0], np.ndarray)):
+        offsets, heap = values
+    else:
+        rows = values
+        if set(map(type, rows)) - {bytes}:
+            rows = _coerce_payloads(rows)
+        lens = np.fromiter(map(len, rows), dtype=np.int64,
+                           count=len(rows)) if len(rows) else np.zeros(
+                               0, np.int64)
+        offsets = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        heap = (np.frombuffer(b"".join(rows), dtype=np.uint8)
+                if int(offsets[-1]) else np.zeros(0, np.uint8))
+        return offsets, heap
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    if offsets.shape != (n + 1,):
+        raise ValueError(
+            f"varlen offsets must be int64[{n + 1}] "
+            f"(got shape {offsets.shape})")
+    if n and int(np.min(np.diff(offsets))) < 0:
+        raise ValueError("varlen offsets must be non-decreasing")
+    heap = np.ascontiguousarray(heap, dtype=np.uint8).reshape(-1)
+    if int(offsets[-1]) > heap.size or int(offsets[0]) < 0:
+        raise ValueError(
+            f"varlen offsets address {int(offsets[-1])} heap bytes but "
+            f"the heap holds {heap.size}")
+    return offsets, heap
+
+
+def _count_cols(op: str, nbytes: int, ns: int, native: bool) -> None:
+    """Columnar twin of :func:`_count` — a separate ``serde.columnar.*``
+    family so the report can split codec-bound verdicts by path."""
+    from sparkrdma_tpu.obs.metrics import global_registry
+
+    reg = global_registry()
+    reg.counter(f"serde.columnar.{op}_bytes").inc(nbytes)
+    reg.counter(f"serde.columnar.{op}_ns").inc(ns)
+    reg.counter(f"serde.columnar.{op}_calls").inc()
+    reg.counter(f"serde.columnar.{op}_native" if native
+                else f"serde.columnar.{op}_fallback").inc()
+
+
+def _cols_native_available() -> bool:
+    """True when encode_cols/decode_cols can dispatch to native (the
+    cols entry points are newer than the v1 codec's — an older prebuilt
+    library may have one but not the other)."""
+    from sparkrdma_tpu.hbm.host_staging import cols_available
+
+    return cols_available()
+
+
+def _coerce_fixed(name: str, kind: str, values, n: int) -> np.ndarray:
+    arr = np.ascontiguousarray(values, dtype=_FIXED_KINDS[kind][1])
+    if arr.shape != (n,):
+        raise ValueError(
+            f"column {name!r} must be {kind}[{n}] (got shape {arr.shape})")
+    return arr
+
+
+def encode_cols(
+    keys: np.ndarray,
+    columns,
+    schema: RowSchema,
+    *,
+    native: Optional[bool] = None,
+    threads: Optional[int] = None,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Encode named columns into record rows under ``schema``.
+
+    ``keys: uint32[N, key_words]``; ``columns`` maps every schema column
+    name to its values — fixed-width columns take any array castable to
+    the declared dtype, the varlen column takes a list of bytes, a
+    :class:`BytesColumn`, or an ``(offsets, heap)`` pair. Returns
+    ``uint32[N, key_words + schema.payload_words]`` rows whose word
+    VALUES are the wire format (same contract as
+    :func:`encode_bytes_rows`; a bytes-only schema produces bit-identical
+    rows). ``native``/``threads``/``out`` as in the v1 encoder.
+    """
+    t0 = time.perf_counter_ns()
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    n, kw = keys.shape
+    missing = set(schema.names) - set(columns)
+    extra = set(columns) - set(schema.names)
+    if missing or extra:
+        raise ValueError(
+            f"columns do not match schema: missing {sorted(missing)}, "
+            f"unexpected {sorted(extra)}")
+    w = kw + schema.payload_words
+    if out is None:
+        out = np.empty((n, w), dtype=np.uint32)
+    elif (out.shape != (n, w) or out.dtype != np.uint32
+          or not out.flags.c_contiguous):
+        raise ValueError(f"out must be C-contiguous uint32[{n}, {w}]")
+    fixed = [(fname, fkind, foff,
+              _coerce_fixed(fname, fkind, columns[fname], n))
+             for fname, fkind, foff in schema.fixed]
+    offsets = heap = None
+    if schema.var_name is not None:
+        offsets, heap = _canon_varlen(columns[schema.var_name], n)
+        lens = np.diff(offsets)
+        if n and int(lens.max(initial=0)) > schema.var_max_bytes:
+            raise _oversize_error(lens, schema.var_max_bytes)
+    use_native = (native is not False and n > 0 and not _native_disabled
+                  and native_codec_available()
+                  and _cols_native_available())
+    if use_native:
+        try:
+            from sparkrdma_tpu import faults as _faults
+            if _faults.fire("serde.encode") == "fail":
+                raise RuntimeError(
+                    "injected fault (serde.encode): native codec failure")
+            from sparkrdma_tpu.hbm.host_staging import load_native
+
+            lib = load_native()
+            ncols = len(fixed)
+            srcs = np.array([a.ctypes.data for _, _, _, a in fixed],
+                            dtype=np.int64)
+            widths = np.array([_FIXED_KINDS[k][0] for _, k, _, _ in fixed],
+                              dtype=np.int64)
+            doffs = np.array([o for _, _, o, _ in fixed], dtype=np.int64)
+            rc = int(lib.sr_encode_cols(
+                keys.ctypes.data, n, kw, w, ncols,
+                srcs.ctypes.data, widths.ctypes.data, doffs.ctypes.data,
+                schema.var_len_word, schema.var_slot_words,
+                schema.var_max_bytes,
+                offsets.ctypes.data if offsets is not None else None,
+                heap.ctypes.data if heap is not None else None,
+                out.ctypes.data, _auto_threads(threads)))
+            if rc < 0:
+                # lengths were validated above, so a native rejection is
+                # a codec inconsistency, not a data error
+                raise RuntimeError(
+                    f"native columnar encoder rejected row {-rc - 1} "
+                    "after validation — codec inconsistency")
+        except ValueError:
+            raise  # data-error contract
+        except Exception as exc:
+            _degrade_native("encode", exc)
+            use_native = False
+    if not use_native:
+        out[:, :kw] = keys
+        for _, fkind, foff, arr in fixed:
+            if fkind == "uint32":
+                out[:, kw + foff] = arr
+            else:
+                # endian-portable lo/hi word-value split (the wire
+                # contract is word VALUES, so this matches the native
+                # memcpy path bit-for-bit on any host)
+                bits = arr.view(np.uint64)
+                out[:, kw + foff] = (bits & 0xFFFFFFFF).astype(np.uint32)
+                out[:, kw + foff + 1] = (bits >> 32).astype(np.uint32)
+        if schema.var_name is not None:
+            lw = kw + schema.var_len_word
+            lens = np.diff(offsets)
+            out[:, lw] = lens.astype(np.uint32)
+            if schema.var_slot_words and n:
+                slot_bytes = schema.var_slot_words * 4
+                slot = np.zeros((n, slot_bytes), dtype=np.uint8)
+                mask = np.arange(slot_bytes)[None, :] < lens[:, None]
+                # boolean-mask assignment runs in C order == row-major
+                # == exactly the heap's row-concatenated order
+                slot[mask] = heap[int(offsets[0]):int(offsets[-1])]
+                out[:, lw + 1:lw + 1 + schema.var_slot_words] = \
+                    slot.view("<u4")
+    _count_cols("encode", out.nbytes, time.perf_counter_ns() - t0,
+                use_native)
+    return out
+
+
+def decode_cols(
+    rows: np.ndarray,
+    key_words: int,
+    schema: RowSchema,
+    *,
+    native: Optional[bool] = None,
+    threads: Optional[int] = None,
+) -> Tuple[np.ndarray, dict]:
+    """Inverse of :func:`encode_cols`: ``(keys, {name: column})``.
+
+    Fixed-width columns come back as **numpy views over ``rows``** —
+    zero copies, zero per-row materialization (the int64/float64 views
+    need a little-endian host and C-contiguous rows; otherwise the
+    values are materialized through endian-portable arithmetic, still
+    without per-row Python objects). The varlen column comes back as a
+    :class:`BytesColumn` (one sharded native gather, or a vectorized
+    numpy gather as the bit-identical fallback). Raises the v1 codec's
+    corrupt-length ValueError, smallest offending row first.
+    """
+    import sys
+
+    t0 = time.perf_counter_ns()
+    rows = np.ascontiguousarray(rows, dtype=np.uint32)
+    n, w = rows.shape
+    if w != key_words + schema.payload_words:
+        raise ValueError(
+            f"rows have {w - key_words} payload words but the schema "
+            f"declares {schema.payload_words}")
+    keys = rows[:, :key_words]
+    cols: dict = {}
+    le = sys.byteorder == "little"
+    for fname, fkind, foff in schema.fixed:
+        c = key_words + foff
+        if fkind == "uint32":
+            cols[fname] = rows[:, c]
+        elif le:
+            # two adjacent uint32 words reinterpreted in place: a
+            # strided VIEW over the receive buffer (numpy allows the
+            # itemsize regroup because the last axis is contiguous)
+            dt = "<i8" if fkind == "int64" else "<f8"
+            cols[fname] = rows[:, c:c + 2].view(dt)[:, 0]
+        else:
+            bits = (rows[:, c].astype(np.uint64)
+                    | rows[:, c + 1].astype(np.uint64) << 32)
+            cols[fname] = bits.view(_FIXED_KINDS[fkind][1])
+    if schema.var_name is not None:
+        lw = key_words + schema.var_len_word
+        slot_words = schema.var_slot_words
+        max_bytes = slot_words * 4
+        lens = rows[:, lw].astype(np.int64)
+        if n and int(lens.max(initial=0)) > max_bytes:
+            i = int(np.argmax(lens > max_bytes))
+            raise ValueError(
+                f"row {i} declares {int(lens[i])} payload bytes but the "
+                f"slot holds {max_bytes} — corrupt length word")
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        heap = np.empty(int(offsets[-1]), dtype=np.uint8)
+        use_native = (native is not False and n > 0 and slot_words > 0
+                      and heap.size > 0 and not _native_disabled
+                      and native_codec_available()
+                      and _cols_native_available())
+        if use_native:
+            try:
+                from sparkrdma_tpu import faults as _faults
+                if _faults.fire("serde.decode") == "fail":
+                    raise RuntimeError(
+                        "injected fault (serde.decode): native codec "
+                        "failure")
+                from sparkrdma_tpu.hbm.host_staging import load_native
+
+                lib = load_native()
+                rc = int(lib.sr_decode_cols(
+                    rows.ctypes.data, n, key_words, w, 0,
+                    None, None, None,
+                    schema.var_len_word, slot_words,
+                    offsets.ctypes.data, heap.ctypes.data,
+                    _auto_threads(threads)))
+                if rc < 0:  # unreachable after validation; defensive
+                    raise ValueError(
+                        f"row {-rc - 1} rejected by native decoder — "
+                        "corrupt length word")
+            except ValueError:
+                raise
+            except Exception as exc:
+                _degrade_native("decode", exc)
+                use_native = False
+        if not use_native and heap.size:
+            blob = np.ascontiguousarray(
+                rows[:, lw + 1:lw + 1 + slot_words].astype(
+                    "<u4")).view(np.uint8).reshape(n, max_bytes)
+            mask = np.arange(max_bytes)[None, :] < lens[:, None]
+            heap[:] = blob[mask]
+        cols[schema.var_name] = BytesColumn(offsets, heap)
+    else:
+        use_native = False  # pure views: nothing to dispatch
+    _count_cols("decode", rows.nbytes, time.perf_counter_ns() - t0,
+                use_native)
+    return keys, cols
+
+
 __all__ = ["encode_bytes_rows", "decode_bytes_rows", "payload_words",
-           "native_codec_available", "codec_totals"]
+           "native_codec_available", "codec_totals", "RowSchema",
+           "BytesColumn", "encode_cols", "decode_cols",
+           "columnar_enabled"]

@@ -423,6 +423,14 @@ class ShuffleReader:
                     serde_encode_s=serde["serde_encode_s"],
                     serde_decode_bytes=serde["serde_decode_bytes"],
                     serde_decode_s=serde["serde_decode_s"],
+                    serde_columnar_encode_bytes=serde[
+                        "serde_columnar_encode_bytes"],
+                    serde_columnar_encode_s=serde[
+                        "serde_columnar_encode_s"],
+                    serde_columnar_decode_bytes=serde[
+                        "serde_columnar_decode_bytes"],
+                    serde_columnar_decode_s=serde[
+                        "serde_columnar_decode_s"],
                     store_spill_bytes=st_totals[0],
                     store_fetch_bytes=st_totals[1],
                     store_prefetch_hits=st_totals[2],

@@ -350,6 +350,24 @@ class ShuffleConf:
     #: (double-buffered through the host staging pool). 0 disables
     #: chunking (one-shot encode, no overlap).
     serde_chunk_records: int = 1 << 20
+    #: dispatch schema-declared datasets to the columnar (v2) codec:
+    #: wide per-column memcpys on encode, numpy column VIEWS on decode
+    #: (no per-row materialization). False pins schema-carrying byte
+    #: payloads to the v1 padded-slot codec — bit-identical rows, the
+    #: knob only trades speed (from_host_columns/to_host_columns always
+    #: use the columnar layout; it is their only representation).
+    serde_schema_columnar: bool = True
+    #: block-compress spilled segments on the DISK tier with this codec
+    #: ("" = store raw, "zlib", "lzma") — reuses the exchange
+    #: compression framing (host_staging.compress_array /
+    #: decompress_blob), so reads auto-detect and the exchange path is
+    #: untouched. Cold columnar frames are highly compressible (zeroed
+    #: slot padding), which is what this knob is for.
+    serde_schema_spill_codec: str = ""
+    #: compression level for serde_schema_spill_codec (zlib 0-9; the
+    #: lzma preset). Level 1 keeps eviction cheap — the spill writer
+    #: runs concurrently with the exchange.
+    serde_schema_spill_level: int = 1
 
     def __post_init__(self) -> None:
         if self.slot_records <= 0:
@@ -431,6 +449,13 @@ class ShuffleConf:
         if self.serde_chunk_records < 0:
             raise ValueError("serde_chunk_records must be >= 0 (0 = no "
                              "chunking)")
+        if self.serde_schema_spill_codec not in ("", "zlib", "lzma"):
+            raise ValueError(
+                f"unknown serde_schema_spill_codec "
+                f"{self.serde_schema_spill_codec!r} "
+                "(supported: '', 'zlib', 'lzma')")
+        if not 0 <= self.serde_schema_spill_level <= 9:
+            raise ValueError("serde_schema_spill_level must be in [0, 9]")
         if not 0.0 <= self.fault_injection_rate <= 1.0:
             raise ValueError("fault_injection_rate must be in [0, 1]")
         if self.retry_backoff_ms < 0:

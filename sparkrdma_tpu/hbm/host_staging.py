@@ -329,6 +329,47 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.sr_has_codec = bool(lib.sr_codec_abi())
     except AttributeError:
         lib.sr_has_codec = False
+    # columnar (v2) codec entry points are newer still — feature-detect
+    # separately so a library with the v1 codec but not the columnar one
+    # keeps v1 native while the columnar layer uses its numpy fallback
+    try:
+        lib.sr_encode_cols.restype = ctypes.c_long
+        lib.sr_encode_cols.argtypes = [
+            ctypes.c_void_p,   # keys  uint32[n * key_words]
+            ctypes.c_int64,    # n
+            ctypes.c_int64,    # key_words
+            ctypes.c_int64,    # row_words
+            ctypes.c_int64,    # ncols  fixed-width column count
+            ctypes.c_void_p,   # srcs  void*[ncols] column storage
+            ctypes.c_void_p,   # widths  int64[ncols] words per element
+            ctypes.c_void_p,   # dst_off int64[ncols] payload word offset
+            ctypes.c_int64,    # var_len_word (-1 = no varlen column)
+            ctypes.c_int64,    # var_slot_words
+            ctypes.c_int64,    # var_max_bytes
+            ctypes.c_void_p,   # var_off int64[n + 1] heap offsets
+            ctypes.c_void_p,   # var_heap uint8[]
+            ctypes.c_void_p,   # out   uint32[n * row_words]
+            ctypes.c_int64,    # threads
+        ]
+        lib.sr_decode_cols.restype = ctypes.c_long
+        lib.sr_decode_cols.argtypes = [
+            ctypes.c_void_p,   # rows  uint32[n * row_words]
+            ctypes.c_int64,    # n
+            ctypes.c_int64,    # key_words
+            ctypes.c_int64,    # row_words
+            ctypes.c_int64,    # ncols  fixed columns to gather
+            ctypes.c_void_p,   # dsts  void*[ncols] contiguous outputs
+            ctypes.c_void_p,   # widths  int64[ncols]
+            ctypes.c_void_p,   # src_off int64[ncols]
+            ctypes.c_int64,    # var_len_word (-1 = no varlen column)
+            ctypes.c_int64,    # var_slot_words
+            ctypes.c_void_p,   # var_off int64[n + 1] heap offsets
+            ctypes.c_void_p,   # var_heap uint8[] out
+            ctypes.c_int64,    # threads
+        ]
+        lib.sr_has_cols = bool(getattr(lib, "sr_has_codec", False))
+    except AttributeError:
+        lib.sr_has_cols = False
     return lib
 
 
@@ -383,6 +424,13 @@ def codec_available() -> bool:
     loaded, codec entry points present, little-endian host."""
     lib = load_native()
     return lib is not None and bool(getattr(lib, "sr_has_codec", False))
+
+
+def cols_available() -> bool:
+    """True when the columnar (v2) codec entry points can be dispatched
+    — newer than the v1 codec ABI, feature-detected separately."""
+    lib = load_native()
+    return lib is not None and bool(getattr(lib, "sr_has_cols", False))
 
 
 class HostBuffer:

@@ -111,6 +111,12 @@ class TieredStore:
         self.pool = pool                     # HBM tier (SlotPool), optional
         self.root = root or conf.spill_tier_dir or conf.spill_dir
         self._use_native = conf.use_native_staging
+        #: disk-tier block compression (serde_schema_spill_codec): cold
+        #: segments — columnar serde frames especially, whose zeroed
+        #: slot padding compresses well — shrink on the way down; reads
+        #: auto-detect via the codec header, so promotion is unchanged
+        self._spill_codec = conf.serde_schema_spill_codec
+        self._spill_level = conf.serde_schema_spill_level
         self._watermark = conf.spill_tier_host_bytes
         self._prefetch_depth = conf.spill_tier_prefetch
         self._reread_attempts = conf.spill_tier_reread_attempts
@@ -518,6 +524,8 @@ class TieredStore:
             path = self._segment_path(seg.key)
             write_array(path, seg.lease.view(seg.dtype, seg.shape),
                         use_native=self._use_native,
+                        codec=self._spill_codec,
+                        level=self._spill_level,
                         pool=self.host_pool)
         except OSError:
             # disk refused (no tier configured / full): leave the
@@ -578,6 +586,8 @@ class TieredStore:
         reg = _reg()
         reg.counter("store.spill_writes").inc()
         reg.counter("store.spill_bytes").inc(seg.nbytes)
+        if self._spill_codec:
+            reg.counter("store.compressed_segments").inc()
         record_active("spill:write", key=seg.key, bytes=seg.nbytes)
         self._set_gauges()
         return True

@@ -391,6 +391,103 @@ long sr_decode_rows(const uint32_t* rows, int64_t n, int64_t key_words,
   });
 }
 
+// ------------------------------------------------- columnar codec (v2)
+// Schema-aware layout (api/serde.py RowSchema): the payload region of a
+// row is a declared sequence of fixed-width columns (uint32 = 1 word,
+// int64/float64 = 2 words, lo|hi word-value encoding == the in-memory
+// layout on the little-endian hosts this path is gated to) plus at most
+// one trailing varlen-bytes column framed exactly like a v1 padded slot
+// (length word + zero-padded bytes). Encode/decode are pure per-column
+// memcpys sharded over run_sharded — no CPython object walking at all,
+// which is what buys the v2 codec its headroom over sr_encode_rows.
+// The Python layer validates schemas, lengths, and offsets BEFORE
+// dispatching, so the length checks here are defensive; both return 0
+// or -(i+1) for the smallest offending row (run_sharded's combine).
+
+// Encode n rows: keys (uint32[n * key_words]) plus ncols fixed columns
+// (srcs[c] = contiguous column storage, widths[c] words per element,
+// dst_off[c] = word offset inside the payload region) plus an optional
+// varlen column (var_len_word >= 0): var_off (int64[n + 1]) indexes
+// var_heap, rows land as [len word | bytes, zero-padded].
+long sr_encode_cols(const uint32_t* keys, int64_t n, int64_t key_words,
+                    int64_t row_words, int64_t ncols,
+                    const void* const* srcs, const int64_t* widths,
+                    const int64_t* dst_off, int64_t var_len_word,
+                    int64_t var_slot_words, int64_t var_max_bytes,
+                    const int64_t* var_off, const uint8_t* var_heap,
+                    uint32_t* out, int64_t threads) {
+  const int64_t var_slot_bytes = var_slot_words * 4;
+  return run_sharded(n, threads, [=](int64_t lo, int64_t hi) -> long {
+    for (int64_t i = lo; i < hi; i++) {
+      uint32_t* row = out + i * row_words;
+      for (int64_t k = 0; k < key_words; k++)
+        row[k] = keys[i * key_words + k];
+      uint32_t* pay = row + key_words;
+      for (int64_t c = 0; c < ncols; c++) {
+        // fragments are 1 or 2 words: plain word stores beat a
+        // runtime-size memcpy call per fragment by a wide margin
+        if (widths[c] == 1) {
+          pay[dst_off[c]] =
+              static_cast<const uint32_t*>(srcs[c])[i];
+        } else if (widths[c] == 2) {
+          uint64_t v;
+          std::memcpy(&v,
+                      static_cast<const uint64_t*>(srcs[c]) + i,
+                      sizeof(v));
+          std::memcpy(pay + dst_off[c], &v, sizeof(v));
+        } else {
+          const int64_t wb = widths[c] * 4;
+          std::memcpy(pay + dst_off[c],
+                      static_cast<const uint8_t*>(srcs[c]) + i * wb,
+                      static_cast<size_t>(wb));
+        }
+      }
+      if (var_len_word >= 0) {
+        const int64_t len = var_off[i + 1] - var_off[i];
+        if (len < 0 || len > var_max_bytes || len > var_slot_bytes)
+          return -(i + 1);
+        pay[var_len_word] = static_cast<uint32_t>(len);
+        uint8_t* dst = reinterpret_cast<uint8_t*>(pay + var_len_word + 1);
+        std::memcpy(dst, var_heap + var_off[i], static_cast<size_t>(len));
+        std::memset(dst + len, 0,
+                    static_cast<size_t>(var_slot_bytes - len));
+      }
+    }
+    return 0;
+  });
+}
+
+// Decode: gather ncols fixed columns into contiguous dsts[c] (src_off[c]
+// = word offset inside the payload region) and/or the varlen bytes into
+// var_heap at var_off[i] (offsets precomputed by the Python layer from
+// the validated length words; fixed-width-only decodes never come here
+// at all — they are numpy VIEWS over the row buffer).
+long sr_decode_cols(const uint32_t* rows, int64_t n, int64_t key_words,
+                    int64_t row_words, int64_t ncols, void* const* dsts,
+                    const int64_t* widths, const int64_t* src_off,
+                    int64_t var_len_word, int64_t var_slot_words,
+                    const int64_t* var_off, uint8_t* var_heap,
+                    int64_t threads) {
+  const int64_t var_slot_bytes = var_slot_words * 4;
+  return run_sharded(n, threads, [=](int64_t lo, int64_t hi) -> long {
+    for (int64_t i = lo; i < hi; i++) {
+      const uint32_t* pay = rows + i * row_words + key_words;
+      for (int64_t c = 0; c < ncols; c++) {
+        const int64_t wb = widths[c] * 4;
+        std::memcpy(static_cast<uint8_t*>(dsts[c]) + i * wb,
+                    pay + src_off[c], static_cast<size_t>(wb));
+      }
+      if (var_len_word >= 0) {
+        const int64_t len = var_off[i + 1] - var_off[i];
+        if (len < 0 || len > var_slot_bytes) return -(i + 1);
+        std::memcpy(var_heap + var_off[i], pay + var_len_word + 1,
+                    static_cast<size_t>(len));
+      }
+    }
+    return 0;
+  });
+}
+
 // -------------------------------------------------------------- spooler
 void* sr_spooler_create(size_t depth) {
   Spooler* sp = new Spooler();

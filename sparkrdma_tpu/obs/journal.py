@@ -96,7 +96,12 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: the multi-tenant service); also carried by rollup cells and the
 #: auxiliary ``{"kind": "admission"}`` fair-queueing wait lines
 #: (sparkrdma_tpu/service/).
-SCHEMA_VERSION = 7
+#: v8: + ``serde_columnar_{encode,decode}_{bytes,s}`` — the columnar
+#: (schema-aware v2) codec's share of the v4 serde totals, also
+#: process-cumulative. The v4 fields remain TOTALS across both codec
+#: paths (pickle share = total − columnar), so pre-v8 consumers and the
+#: rollup's serde series keep their meaning unchanged.
+SCHEMA_VERSION = 8
 
 
 @dataclasses.dataclass
@@ -151,6 +156,12 @@ class ExchangeSpan:
     # --- multi-tenant service identity (schema v7): "" when the read
     # ran outside a service session (single-tenant compat) ---
     tenant: str = ""
+    # --- columnar codec share of the v4 serde totals (schema v8) —
+    # PROCESS-CUMULATIVE; pickle-path share = v4 total − columnar ---
+    serde_columnar_encode_bytes: int = 0
+    serde_columnar_encode_s: float = 0.0
+    serde_columnar_decode_bytes: int = 0
+    serde_columnar_decode_s: float = 0.0
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

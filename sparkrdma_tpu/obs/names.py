@@ -64,6 +64,7 @@ COUNTERS = frozenset({
     "store.prefetch_hits",
     "store.sync_fetches",
     "store.crc_rereads",
+    "store.compressed_segments",
     "service.admits",
     "service.admission_waits",
     "service.sessions_opened",
@@ -109,6 +110,11 @@ WILDCARDS = frozenset({
     "serde.*_calls",
     "serde.*_native",
     "serde.*_fallback",
+    "serde.columnar.*_bytes",
+    "serde.columnar.*_ns",
+    "serde.columnar.*_calls",
+    "serde.columnar.*_native",
+    "serde.columnar.*_fallback",
     "tenant.*.hbm_slots",
     "tenant.*.host_bytes",
     "tenant.*.disk_bytes",

@@ -1,7 +1,7 @@
 """Child-process body for the sanitizer test legs.
 
-Run as ``python tests/sanitizer_worker.py {probe|fuzz|planes|tenants}``
-with
+Run as ``python tests/sanitizer_worker.py
+{probe|fuzz|columnar|planes|tenants}`` with
 ``SPARKRDMA_NATIVE_FLAVOR=tsan|asan`` set and the matching sanitizer
 runtime LD_PRELOADed — ``tests/test_sanitizers.py`` does both. The
 point of a separate script (deliberately NOT named ``test_*.py``, so
@@ -16,6 +16,12 @@ parent can skip (not fail) on machines without sanitizer runtimes.
 (thread counts 1/2/8, degenerate batches, error paths, decode-plan
 validation) plus the CRC/decompress corruption paths, which is where
 a data race or heap overflow in ``native/staging.cpp`` would surface.
+``columnar`` replays the v2 codec's fuzz matrix from
+``tests/test_columnar.py`` — mixed fixed-width + varlen schemas through
+``sr_encode_cols``/``sr_decode_cols`` across the same thread counts and
+degenerate shapes (0 rows, empty heaps, max-length slots), error paths
+included — the per-column fragment stores and the sharded heap gather
+are a fresh race/overflow surface the v1 matrix never touches.
 ``planes`` churns the long-lived Python thread planes — the tiered
 store's writer/prefetcher (concurrent put/fetch/prefetch/evict with
 wanted-flag races, spill I/O through the instrumented native file
@@ -90,6 +96,71 @@ def _serde_matrix(serde, np) -> None:
     for native in (True, False):
         try:
             decode_bytes_rows(rows, 2, native=native)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("corrupt length word not rejected")
+
+
+def _columnar_matrix(serde, np) -> None:
+    """The TestNativeNumpyParity fuzz contract for the v2 codec,
+    replayed verbatim: native and numpy columnar paths must produce
+    bit-identical rows and identical columns across thread counts and
+    degenerate shapes, and reject data errors from both paths without
+    the native side touching out-of-bounds memory."""
+    from sparkrdma_tpu.api.serde import (RowSchema, decode_cols,
+                                         encode_cols)
+
+    for threads in (1, 2, 8):
+        rng = np.random.default_rng(2000 + threads)
+        for trial in range(6):
+            n = int(rng.integers(0, 400))
+            kw = int(rng.integers(1, 4))
+            maxb = int(rng.integers(0, 64))
+            schema = RowSchema([("a", "uint32"), ("b", "int64"),
+                                ("c", "float64"),
+                                ("p", ("bytes", maxb))])
+            keys = rng.integers(0, 2**32, size=(n, kw), dtype=np.uint32)
+            lens = rng.integers(0, maxb + 1, size=n)
+            if n:
+                lens[0] = 0            # empty row
+                lens[-1] = maxb        # max-length slot
+            cols = {"a": rng.integers(0, 2**32, size=n, dtype=np.uint32),
+                    "b": rng.integers(-2**62, 2**62, size=n,
+                                      dtype=np.int64),
+                    "c": rng.standard_normal(n),
+                    "p": [rng.bytes(int(k)) for k in lens]}
+            nat = encode_cols(keys, cols, schema, native=True,
+                              threads=threads)
+            ref = encode_cols(keys, cols, schema, native=False)
+            assert (nat == ref).all(), "native/numpy cols rows diverged"
+            for native in (True, False):
+                k, dec = decode_cols(nat, kw, schema, native=native,
+                                     threads=threads)
+                assert (np.asarray(k) == keys).all()
+                assert (np.asarray(dec["a"]) == cols["a"]).all()
+                assert (np.asarray(dec["b"]) == cols["b"]).all()
+                assert (np.asarray(dec["c"]) == cols["c"]).all()
+                assert dec["p"] == cols["p"]
+
+    # error paths from BOTH codecs: oversize varlen value (encode) and
+    # corrupt length word (decode)
+    schema = RowSchema([("a", "uint32"), ("p", ("bytes", 8))])
+    keys = np.zeros((3, 2), np.uint32)
+    a = np.arange(3, dtype=np.uint32)
+    for native in (True, False):
+        try:
+            encode_cols(keys, {"a": a, "p": [b"ok", b"x" * 9, b"y" * 9]},
+                        schema, native=native)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("oversize varlen value not rejected")
+    rows = encode_cols(keys, {"a": a, "p": [b"a", b"bb", b"ccc"]}, schema)
+    rows[1, 2 + schema.var_len_word] = 999
+    for native in (True, False):
+        try:
+            decode_cols(rows, 2, schema, native=native)
         except ValueError:
             pass
         else:
@@ -411,6 +482,16 @@ def main(mode: str) -> int:
               f"(flavor={hs.native_flavor() or 'plain'})")
         return 0
 
+    if mode == "columnar":
+        if not serde._cols_native_available():
+            print("sanitizer worker: native columnar (v2) entry points "
+                  "unavailable", file=sys.stderr)
+            return CODEC_UNAVAILABLE
+        _columnar_matrix(serde, np)
+        print("sanitizer worker: columnar ok "
+              f"(flavor={hs.native_flavor() or 'plain'})")
+        return 0
+
     if mode == "planes":
         _store_plane(np)
         _watchdog_plane(np)
@@ -425,7 +506,8 @@ def main(mode: str) -> int:
               f"(flavor={hs.native_flavor() or 'plain'})")
         return 0
 
-    print(f"unknown mode {mode!r} (expected probe|fuzz|planes|tenants)",
+    print(f"unknown mode {mode!r} "
+          "(expected probe|fuzz|columnar|planes|tenants)",
           file=sys.stderr)
     return 2
 

@@ -1,0 +1,407 @@
+"""Columnar zero-copy serde v2: native<->numpy parity fuzz across
+thread counts and degenerate shapes, error-message parity with the v1
+codec (offending row index included), bytes-only bit-identity with v1
+rows, schema round trips through real shuffle verbs, spill/resume of a
+columnar frame with CRC + in-codec compression on, and bit-equality of
+both rungs of the degradation ladder."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.api import serde
+from sparkrdma_tpu.api.serde import (BytesColumn, RowSchema, decode_cols,
+                                     decode_bytes_rows, encode_cols,
+                                     encode_bytes_rows)
+from sparkrdma_tpu.config import ShuffleConf
+from sparkrdma_tpu.obs.metrics import global_registry
+
+# payload_words(37) == 1 + 10 == 11 == this mixed schema's payload
+# width, so ONE manager (val_words=11) serves both schema shapes below
+MIXED = RowSchema([("a", "uint32"), ("b", "int64"), ("c", "float64"),
+                   ("tag", ("bytes", 17))])
+BYTES_ONLY = RowSchema.bytes_only(37)
+FIXED_ONLY = RowSchema([("a", "uint32"), ("b", "int64"),
+                        ("c", "float64")])
+
+
+@pytest.fixture(scope="session")
+def cols_native(native_codec):
+    """The v2 entry points are newer than the v1 codec's: skip when the
+    loaded library predates ``sr_encode_cols``/``sr_decode_cols``."""
+    if not serde._cols_native_available():
+        pytest.skip("native columnar (v2) entry points unavailable")
+    return True
+
+
+def _mixed_batch(rng, n, lens=None):
+    keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
+    if lens is None:
+        lens = rng.integers(0, 18, size=n)
+    payloads = [bytes(rng.integers(0, 256, size=int(ln), dtype=np.uint8))
+                for ln in lens]
+    cols = {"a": rng.integers(0, 2**32, size=n, dtype=np.uint32),
+            "b": rng.integers(-2**62, 2**62, size=n, dtype=np.int64),
+            "c": rng.standard_normal(n),
+            "tag": payloads}
+    return keys, cols
+
+
+def _assert_cols_equal(schema, got, want):
+    for name, kind in schema.fields:
+        if name == schema.var_name:
+            assert got[name] == list(want[name]) or \
+                got[name] == want[name]
+        else:
+            np.testing.assert_array_equal(np.asarray(got[name]),
+                                          np.asarray(want[name]))
+
+
+class TestNativeNumpyParity:
+    """The native columnar codec must be BIT-IDENTICAL to the numpy
+    fallback — same rows out of encode, same columns out of decode —
+    across thread counts and the degenerate shapes that break sharded
+    loops (0 rows, all-empty heaps, max-length slots)."""
+
+    CASES = {
+        "mixed": lambda rng: _mixed_batch(rng, 257),
+        "zero_rows": lambda rng: _mixed_batch(rng, 0),
+        "empty_payloads": lambda rng: _mixed_batch(
+            rng, 64, lens=np.zeros(64, np.int64)),
+        "max_len": lambda rng: _mixed_batch(
+            rng, 64, lens=np.full(64, 17, np.int64)),
+        "varlen_heavy": lambda rng: _mixed_batch(
+            rng, 512, lens=np.where(np.arange(512) % 3 == 0, 17,
+                                    np.arange(512) % 18)),
+    }
+
+    @pytest.mark.parametrize("threads", [1, 2, 8])
+    @pytest.mark.parametrize("case", sorted(CASES))
+    def test_encode_decode_parity(self, cols_native, threads, case):
+        rng = np.random.default_rng(hash((threads, case)) % 2**32)
+        keys, cols = self.CASES[case](rng)
+        nat = encode_cols(keys, cols, MIXED, native=True, threads=threads)
+        ref = encode_cols(keys, cols, MIXED, native=False)
+        np.testing.assert_array_equal(nat, ref)
+        k_nat, c_nat = decode_cols(nat, 2, MIXED, native=True,
+                                   threads=threads)
+        k_ref, c_ref = decode_cols(ref, 2, MIXED, native=False)
+        np.testing.assert_array_equal(k_nat, keys)
+        np.testing.assert_array_equal(k_ref, keys)
+        _assert_cols_equal(MIXED, c_nat, cols)
+        _assert_cols_equal(MIXED, c_ref, cols)
+        assert c_nat["tag"] == c_ref["tag"]
+
+    def test_fixed_only_parity(self, cols_native):
+        rng = np.random.default_rng(5)
+        keys, cols = _mixed_batch(rng, 128)
+        cols = {k: v for k, v in cols.items() if k != "tag"}
+        nat = encode_cols(keys, cols, FIXED_ONLY, native=True)
+        ref = encode_cols(keys, cols, FIXED_ONLY, native=False)
+        np.testing.assert_array_equal(nat, ref)
+        _, got = decode_cols(nat, 2, FIXED_ONLY)
+        _assert_cols_equal(FIXED_ONLY, got, cols)
+        # the whole point of v2: fixed-width decode is VIEWS over the
+        # row frame, not copies
+        assert got["a"].base is not None
+        assert got["b"].base is not None
+
+    def test_bytes_only_bit_identical_to_v1(self, cols_native):
+        """A bytes-only schema's rows ARE v1 rows — the property the
+        columnar->v1 degradation rung relies on for identical outputs."""
+        rng = np.random.default_rng(9)
+        keys = rng.integers(1, 2**32 - 1, size=(100, 2), dtype=np.uint32)
+        payloads = [bytes(rng.integers(0, 256, size=int(ln),
+                                       dtype=np.uint8))
+                    for ln in rng.integers(0, 38, size=100)]
+        v1 = encode_bytes_rows(keys, payloads, 37)
+        for native in (True, False):
+            v2 = encode_cols(keys, {"payload": payloads}, BYTES_ONLY,
+                             native=native)
+            np.testing.assert_array_equal(v2, v1)
+        # both decoders read each other's rows
+        k, cols = decode_cols(v1, 2, BYTES_ONLY)
+        assert cols["payload"] == payloads
+        k1, p1 = decode_bytes_rows(
+            encode_cols(keys, {"payload": payloads}, BYTES_ONLY), 2)
+        np.testing.assert_array_equal(k1, keys)
+        assert p1 == payloads
+
+    def test_bytescolumn_reencode_round_trip(self, cols_native):
+        """decode -> re-encode through the offsets+heap form (no Python
+        object per row) reproduces the frame bit-for-bit."""
+        rng = np.random.default_rng(11)
+        keys, cols = _mixed_batch(rng, 200)
+        rows = encode_cols(keys, cols, MIXED)
+        k, dec = decode_cols(rows, 2, MIXED)
+        again = encode_cols(np.asarray(k), dec, MIXED)
+        np.testing.assert_array_equal(again, rows)
+
+
+class TestErrorMessageParity:
+    """Data errors must raise the SAME ValueError text (offending row
+    index first) on every path: v1, columnar-native, columnar-numpy."""
+
+    def _oversize_batch(self):
+        keys = np.ones((3, 2), dtype=np.uint32)
+        payloads = [b"ok", b"x" * 38, b"x" * 38]   # rows 1 and 2 too big
+        return keys, payloads
+
+    def test_oversize_parity_with_v1(self):
+        keys, payloads = self._oversize_batch()
+        msgs = set()
+        with pytest.raises(ValueError, match="payload 1 is 38 bytes") as e:
+            encode_bytes_rows(keys, payloads, 37)
+        msgs.add(str(e.value))
+        for native in (False, None):
+            with pytest.raises(ValueError,
+                               match="payload 1 is 38 bytes") as e:
+                encode_cols(keys, {"payload": payloads}, BYTES_ONLY,
+                            native=native)
+            msgs.add(str(e.value))
+        assert len(msgs) == 1, f"oversize messages diverged: {msgs}"
+
+    def test_corrupt_length_parity_with_v1(self):
+        rng = np.random.default_rng(3)
+        keys = rng.integers(1, 2**32 - 1, size=(4, 2), dtype=np.uint32)
+        rows = encode_bytes_rows(keys, [b"a", b"b", b"c", b"d"], 37)
+        rows[1, 2 + BYTES_ONLY.var_len_word] = 999   # corrupt length
+        msgs = set()
+        with pytest.raises(ValueError, match="row 1 declares 999") as e:
+            decode_bytes_rows(rows, 2)
+        msgs.add(str(e.value))
+        for native in (False, None):
+            with pytest.raises(ValueError,
+                               match="row 1 declares 999") as e:
+                decode_cols(rows, 2, BYTES_ONLY, native=native)
+            msgs.add(str(e.value))
+        assert len(msgs) == 1, f"corrupt-length messages diverged: {msgs}"
+
+    def test_schema_validation_errors(self):
+        with pytest.raises(ValueError, match="reserved"):
+            RowSchema([("keys", "uint32")])
+        with pytest.raises(ValueError, match="duplicate"):
+            RowSchema([("a", "uint32"), ("a", "int64")])
+        with pytest.raises(ValueError, match="must be the LAST"):
+            RowSchema([("p", ("bytes", 8)), ("a", "uint32")])
+        with pytest.raises(ValueError, match="unknown kind"):
+            RowSchema([("a", "int32")])
+        with pytest.raises(ValueError, match="columns do not match"):
+            encode_cols(np.ones((1, 2), np.uint32), {"z": [0]},
+                        FIXED_ONLY)
+
+
+# ----------------------------------------------------------------------
+# schema round trip through real shuffle verbs
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def manager():
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    m = ShuffleManager(conf=ShuffleConf(slot_records=256, val_words=11))
+    yield m
+    m.stop()
+
+
+def _verb_batch(rng, n):
+    # unique keys (lo word is a permutation) so sort order is total and
+    # the sorted output is comparable column-for-column
+    keys = np.empty((n, 2), dtype=np.uint32)
+    keys[:, 0] = rng.integers(1, 2**31, size=n, dtype=np.uint32)
+    keys[:, 1] = rng.permutation(n).astype(np.uint32) + 1
+    _, cols = _mixed_batch(rng, n)
+    return keys, cols
+
+
+class TestSchemaThroughVerbs:
+    def test_sort_by_key_preserves_schema_and_columns(self, manager, rng):
+        from sparkrdma_tpu.api.dataset import Dataset
+
+        n = 8 * 64
+        keys, cols = _verb_batch(rng, n)
+        ds = Dataset.from_host_columns(manager, keys, cols, MIXED)
+        assert ds.schema == MIXED
+        out = ds.repartition().sort_by_key()
+        assert out.schema == MIXED, "schema must survive exchange verbs"
+        got_keys, got_cols = out.to_host_columns()
+        got_keys = np.asarray(got_keys)
+        assert got_keys.shape == (n, 2)
+        order = np.lexsort((keys[:, 1], keys[:, 0]))
+        np.testing.assert_array_equal(got_keys, keys[order])
+        for name in ("a", "b", "c"):
+            np.testing.assert_array_equal(np.asarray(got_cols[name]),
+                                          np.asarray(cols[name])[order])
+        assert got_cols["tag"] == [cols["tag"][i] for i in order]
+
+    def test_bytes_only_payload_round_trip(self, manager, rng):
+        from sparkrdma_tpu.api.dataset import Dataset
+
+        n = 8 * 32
+        keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
+        payloads = [bytes(rng.integers(0, 256, size=int(ln),
+                                       dtype=np.uint8))
+                    for ln in rng.integers(0, 38, size=n)]
+        ds = Dataset.from_host_payloads(manager, keys, payloads, 37,
+                                        schema=BYTES_ONLY)
+        got_keys, got_payloads = ds.to_host_payloads()
+        assert isinstance(got_payloads, BytesColumn), \
+            "bytes-only schema decode must return the lazy column"
+        np.testing.assert_array_equal(np.asarray(got_keys), keys)
+        assert got_payloads == payloads
+
+    def test_aggregation_drops_schema(self, manager, rng):
+        from sparkrdma_tpu.api.dataset import Dataset
+
+        n = 8 * 16
+        keys, cols = _verb_batch(rng, n)
+        ds = Dataset.from_host_columns(manager, keys, cols, MIXED)
+        agg = ds.reduce_by_key("sum")
+        assert agg.schema is None, \
+            "aggregation rewrites payloads — the layout no longer holds"
+        with pytest.raises(ValueError, match="needs a schema"):
+            agg.to_host_columns()
+
+
+# ----------------------------------------------------------------------
+# degradation ladder: both rungs fall back bit-identically
+# ----------------------------------------------------------------------
+
+class TestDegradationLadder:
+    def test_columnar_rung_falls_back_bit_identical(self, manager, rng):
+        """Force the sticky columnar->v1 degradation: the v1 path must
+        produce BYTE-IDENTICAL device records and equal host payloads
+        (legal because bytes-only columnar rows == v1 rows)."""
+        from sparkrdma_tpu import faults
+        from sparkrdma_tpu.api.dataset import Dataset
+
+        n = 8 * 16
+        keys = rng.integers(1, 2**32 - 1, size=(n, 2), dtype=np.uint32)
+        payloads = [bytes(rng.integers(0, 256, size=int(ln),
+                                       dtype=np.uint8))
+                    for ln in rng.integers(0, 38, size=n)]
+        serde._reset_columnar_degrade()
+        try:
+            ds_col = Dataset.from_host_payloads(manager, keys, payloads,
+                                                37, schema=BYTES_ONLY)
+            rec_col = np.asarray(ds_col.records)
+            base = global_registry().counter(
+                "degrade.serde_columnar").value
+            serde._degrade_columnar("test", RuntimeError("forced"))
+            assert not serde.columnar_enabled()
+            assert global_registry().counter(
+                "degrade.serde_columnar").value - base == 1
+            ds_v1 = Dataset.from_host_payloads(manager, keys, payloads,
+                                               37, schema=BYTES_ONLY)
+            np.testing.assert_array_equal(np.asarray(ds_v1.records),
+                                          rec_col)
+            # decode degrades too: the v1 list path, same values
+            k2, p2 = ds_v1.to_host_payloads()
+            assert isinstance(p2, list)
+            np.testing.assert_array_equal(np.asarray(k2), keys)
+            assert p2 == payloads
+        finally:
+            serde._reset_columnar_degrade()
+            faults.reset_accounting()
+
+    def test_native_rung_falls_back_bit_identical(self, cols_native):
+        from sparkrdma_tpu import faults
+
+        rng = np.random.default_rng(21)
+        keys, cols = _mixed_batch(rng, 300)
+        want = encode_cols(keys, cols, MIXED, native=True)
+        try:
+            serde._degrade_native("test", RuntimeError("forced"))
+            got = encode_cols(keys, cols, MIXED)   # auto path -> numpy
+            np.testing.assert_array_equal(got, want)
+            _, dec = decode_cols(want, 2, MIXED)
+            _assert_cols_equal(MIXED, dec, cols)
+        finally:
+            serde._reset_native_degrade()
+            faults.reset_accounting()
+
+
+# ----------------------------------------------------------------------
+# spill/resume of a columnar frame: CRC framing + in-codec compression
+# ----------------------------------------------------------------------
+
+class TestColumnarSpill:
+    def _frame(self, n=512):
+        # compressible content (zero-padded slots, small ints) so the
+        # size assertion below is meaningful
+        keys = np.stack([np.arange(n, dtype=np.uint32),
+                         np.arange(n, dtype=np.uint32) * 3 + 1], axis=1)
+        cols = {"a": np.arange(n, dtype=np.uint32),
+                "b": np.arange(n, dtype=np.int64) - n // 2,
+                "c": np.linspace(0.0, 1.0, n),
+                "tag": [b"x" * (i % 5) for i in range(n)]}
+        return keys, cols, encode_cols(keys, cols, MIXED, native=False)
+
+    def _store(self, tmp_path, **kw):
+        from sparkrdma_tpu.hbm.tiered_store import TieredStore
+
+        return TieredStore(ShuffleConf(
+            spill_tier_dir=str(tmp_path / "tier"),
+            spill_tier_host_bytes=0, spill_tier_prefetch=0, **kw))
+
+    def test_compressed_segment_spill_and_fetch(self, tmp_path):
+        keys, cols, rows = self._frame()
+        store = self._store(tmp_path, serde_schema_spill_codec="zlib",
+                            serde_schema_spill_level=6)
+        base = global_registry().counter(
+            "store.compressed_segments").value
+        try:
+            store.put("frame", rows)
+            store.drain()
+            assert store.tier_of("frame") == "disk"
+            assert global_registry().counter(
+                "store.compressed_segments").value - base == 1
+            path = os.path.join(store.root, "frame.seg")
+            assert os.path.getsize(path) < rows.nbytes, \
+                "in-codec compression must shrink a compressible frame"
+            fetched = store.get("frame")
+            np.testing.assert_array_equal(fetched, rows)
+            # the resumed frame decodes straight back into columns
+            k, dec = decode_cols(fetched, 2, MIXED)
+            np.testing.assert_array_equal(np.asarray(k), keys)
+            _assert_cols_equal(MIXED, dec, cols)
+        finally:
+            store.close(delete_disk=True)
+
+    def test_crc_covers_compressed_frames(self, tmp_path):
+        """A bit flip inside a COMPRESSED segment must fail the CRC
+        check, not surface as a zlib/codec error or silent corruption."""
+        _, _, rows = self._frame(128)
+        store = self._store(tmp_path, serde_schema_spill_codec="zlib",
+                            spill_tier_reread_attempts=2)
+        try:
+            store.put("frame", rows)
+            store.drain()
+            path = os.path.join(store.root, "frame.seg")
+            with open(path, "r+b") as f:
+                f.seek(os.path.getsize(path) // 2)
+                b = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([b[0] ^ 0xFF]))
+            with pytest.raises(OSError, match="unreadable"):
+                store.get("frame")
+        finally:
+            store.close(delete_disk=True)
+
+    def test_uncompressed_default_unchanged(self, tmp_path):
+        """codec='' (the default) keeps the raw CRC frame — byte layout
+        and counters identical to pre-v8 stores."""
+        _, _, rows = self._frame(64)
+        store = self._store(tmp_path)
+        base = global_registry().counter(
+            "store.compressed_segments").value
+        try:
+            store.put("frame", rows)
+            store.drain()
+            assert store.tier_of("frame") == "disk"
+            assert global_registry().counter(
+                "store.compressed_segments").value == base
+            np.testing.assert_array_equal(store.get("frame"), rows)
+        finally:
+            store.close(delete_disk=True)

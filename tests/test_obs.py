@@ -153,7 +153,7 @@ class TestJournal:
                          retry_count=1, pool_high_water=4, spill_count=2)
         d = span.to_dict()
         assert d["total_bytes"] == span.records * span.record_bytes
-        assert d["schema"] == 7
+        assert d["schema"] == 8
         back = ExchangeSpan.from_dict(d)
         assert back == span
 
@@ -221,8 +221,8 @@ V1_FIELDS = ("span_id", "shuffle_id", "transport", "rounds", "dispatches",
 
 class TestSchemaVersioning:
     def test_schema_version_is_five(self):
-        assert SCHEMA_VERSION == 7
-        assert make_span().schema == 7
+        assert SCHEMA_VERSION == 8
+        assert make_span().schema == 8
 
     def test_v1_line_parses_under_v2_reader(self):
         """A journal written before the timeline existed still reads:
@@ -385,6 +385,67 @@ class TestMultiJournalReport:
             "no issues detected: skew, spills, stalls, retries and "
             "degradations all within normal bounds"]
 
+    def test_serde_codec_path_split(self):
+        """v8 split: legacy serde fields are TOTALS across both codec
+        paths; the report derives the pickle share by difference and
+        gives each path its own bound verdict."""
+        spans = [make_span(
+            records=5000, record_bytes=1000, exchange_s=0.05,
+            serde_encode_bytes=3_000_000, serde_encode_s=0.05,
+            serde_decode_bytes=3_000_000, serde_decode_s=0.05,
+            serde_columnar_encode_bytes=2_000_000,
+            serde_columnar_encode_s=0.001,
+            serde_columnar_decode_bytes=2_000_000,
+            serde_columnar_decode_s=0.001).to_dict()]
+        sd = shuffle_report.aggregate(spans)["serde"]
+        assert sd["encode_bytes"] == 3_000_000          # total, both paths
+        assert sd["columnar"]["encode_bytes"] == 2_000_000
+        assert sd["pickle"]["encode_bytes"] == 1_000_000
+        assert sd["columnar"]["encode_mbps"] == pytest.approx(2000.0)
+        assert sd["pickle"]["encode_mbps"] == pytest.approx(
+            1_000_000 / 0.049 / 1e6, rel=1e-3)
+        fabric = sd["fabric_mbps"]
+        assert fabric == pytest.approx(100.0)           # 5 MB / 0.05 s
+        # per-path verdicts: fast columnar is fabric-bound while the
+        # slow pickle slice is codec-bound on the SAME fabric rate
+        assert shuffle_report._bound_verdict(
+            sd["columnar"], fabric=fabric).startswith("fabric")
+        assert shuffle_report._bound_verdict(
+            sd["pickle"], fabric=fabric).startswith("CODEC")
+
+    def test_doctor_pickle_fallback_suggests_schema(self):
+        spans = [make_span(
+            records=5000, record_bytes=1000, exchange_s=0.05,
+            serde_encode_bytes=3_000_000, serde_encode_s=0.05,
+            serde_decode_bytes=3_000_000, serde_decode_s=0.05,
+            serde_columnar_encode_bytes=2_000_000,
+            serde_columnar_encode_s=0.001,
+            serde_columnar_decode_bytes=2_000_000,
+            serde_columnar_decode_s=0.001).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any("codec-bound on the pickle codec" in f
+                   for f in findings)
+        assert not any("codec-bound on the columnar codec" in f
+                       for f in findings)
+        assert any("declare a RowSchema" in f and
+                   "part of the byte-payload serde work" in f
+                   for f in findings)
+        # pickle-only journal (no columnar bytes): the suggestion covers
+        # ALL the serde work
+        solo = [make_span(
+            records=5000, record_bytes=1000, exchange_s=0.05,
+            serde_encode_bytes=3_000_000, serde_encode_s=0.05,
+            serde_decode_bytes=3_000_000, serde_decode_s=0.05).to_dict()]
+        findings = shuffle_report.diagnose(solo, [])
+        assert any(f.startswith("the byte-payload serde work")
+                   and "declare a RowSchema" in f for f in findings)
+
+    def test_doctor_columnar_degradation_hint(self):
+        spans = [make_span(degraded=["serde_columnar"]).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any("serde_columnar" in f and "v1 row codec" in f
+                   for f in findings)
+
     def test_doctor_cli_flag(self, tmp_path, capsys):
         p0 = self._host_journal(tmp_path, 0, exchange_s=0.1,
                                 peers=(93, 1, 1, 1, 1, 1, 1, 1))
@@ -469,7 +530,7 @@ class TestManagerJournalE2E:
         manager, plan = self._run_shuffle(conf, rng)
         (span,) = read_journal(str(sink))
         assert span.shuffle_id == 90
-        assert span.schema == 7
+        assert span.schema == 8
         assert span.transport == conf.transport
         assert span.rounds == plan.num_rounds
         assert span.records == plan.total_records

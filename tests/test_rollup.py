@@ -362,6 +362,10 @@ class TestSchemaV2V3:
             "store_spill_bytes", "store_fetch_bytes",   # v6: tiered store
             "store_prefetch_hits", "store_sync_fetches",
             "tenant",                          # v7: multi-tenant service
+            "serde_columnar_encode_bytes",     # v8: columnar codec share
+            "serde_columnar_encode_s",
+            "serde_columnar_decode_bytes",
+            "serde_columnar_decode_s",
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)
