@@ -9,7 +9,12 @@ counter-name sync, timeline pairing, guarded-by discipline, assert
 safety, never-raise I/O) and the interprocedural concurrency rules
 (lock-order, blocking-under-lock, guarded-by-inference,
 condition-wait-loop, thread-lifecycle — call-graph + lock-model
-analysis from ``sparkrdma_tpu/lint/rules_concurrency.py``); this shim
+analysis from ``sparkrdma_tpu/lint/rules_concurrency.py``), the
+resource-lifecycle rules (resource-leak, teardown-completeness —
+acquisition/discharge tracking over the same call graph, from
+``rules_resources.py``), and the cross-language native-ABI rules
+(abi-sync, abi-gate — ``extern "C"`` exports vs ctypes declarations
+and probe-gated optional symbols, from ``rules_abi.py``); this shim
 runs the *full* rule set so the tier-1 command from ROADMAP.md keeps
 working unchanged while enforcing everything.
 

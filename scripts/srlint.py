@@ -7,11 +7,22 @@ Usage:
     python scripts/srlint.py --select a,b    # only the named rules
     python scripts/srlint.py --json          # machine-readable findings
     python scripts/srlint.py --select lock-order --dot   # DOT lock graph
+    python scripts/srlint.py --changed       # working-tree files only
+    python scripts/srlint.py --changed main..HEAD        # a git range
 
 Exit code 0 when no finding survives suppression, 1 otherwise (2 for
 usage errors such as an unknown rule id). Human output is one
 ``path:line: [rule-id] message`` block per finding; ``--json`` emits
-``{"rules": [...], "findings": [...]}``.
+``{"rules": [...], "findings": [...]}`` (each finding carries its
+rule's ``kind``).
+
+``--changed`` is the pre-commit fast path: with no value it takes the
+files touched in the working tree (``git status --porcelain``), with a
+value the files of that ``git diff`` range. Rules are whole-repo
+analyses (a call-graph edge from an untouched file can implicate a
+touched one), so the engine still runs everything — the mode filters
+*reporting* to the changed files and short-circuits to success when
+nothing relevant changed. Exit codes are unchanged.
 
 The rule set lives in ``sparkrdma_tpu/lint/``; see the package
 docstring there for the suppression syntax and how to add a rule.
@@ -24,16 +35,39 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
 
+def _changed_files(root: str, rev_range: str) -> set:
+    """Repo-relative paths touched in the working tree (no range) or in
+    ``git diff <range>``; raises CalledProcessError outside a repo."""
+    if rev_range:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", rev_range], cwd=root,
+            capture_output=True, text=True, check=True).stdout
+        return {line.strip() for line in out.splitlines() if line.strip()}
+    out = subprocess.run(
+        ["git", "status", "--porcelain"], cwd=root,
+        capture_output=True, text=True, check=True).stdout
+    paths = set()
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:          # rename: lint the new name
+            path = path.split(" -> ", 1)[1]
+        paths.add(path.strip().strip('"'))
+    return paths
+
+
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, str(REPO))
-    from sparkrdma_tpu.lint import all_rules, run_rules
+    from sparkrdma_tpu.lint import all_rules, get_rule, run_rules
 
     ap = argparse.ArgumentParser(
         prog="srlint", description="static-analysis rules for this repo")
@@ -48,6 +82,11 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", action="store_true",
                     help="print the lock acquisition graph as Graphviz "
                          "DOT on stdout (findings go to stderr)")
+    ap.add_argument("--changed", nargs="?", const="", default=None,
+                    metavar="RANGE",
+                    help="report only findings in files touched in the "
+                         "working tree (no value) or in the given git "
+                         "diff range; exits 0 early when none changed")
     args = ap.parse_args(argv)
 
     rules = all_rules()
@@ -72,7 +111,23 @@ def main(argv=None) -> int:
                   f"(try --list-rules)", file=sys.stderr)
             return 2
 
+    changed = None
+    if args.changed is not None:
+        try:
+            changed = _changed_files(args.root, args.changed)
+        except (subprocess.CalledProcessError, OSError) as exc:
+            print(f"srlint: --changed failed: {exc}", file=sys.stderr)
+            return 2
+        if not changed:
+            print("srlint: no changed files, nothing to lint")
+            return 0
+
     findings = run_rules(args.root, select=select)
+    if changed is not None:
+        # rule crashes ("<srlint>") always survive the filter — a broken
+        # lint must fail loudly no matter which files changed
+        findings = [f for f in findings
+                    if f.path in changed or f.path == "<srlint>"]
     if args.dot:
         from sparkrdma_tpu.lint.rules_concurrency import render_lock_dot
         print(render_lock_dot(args.root))
@@ -84,7 +139,8 @@ def main(argv=None) -> int:
             "root": str(args.root),
             "rules": sorted({r.id for r in rules}
                             if select is None else select),
-            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+            "findings": [{"rule": f.rule, "kind": get_rule(f.rule).kind,
+                          "path": f.path, "line": f.line,
                           "message": f.message} for f in findings],
         }, indent=2))
     else:

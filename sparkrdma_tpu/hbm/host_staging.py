@@ -258,6 +258,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sr_alloc.argtypes = [ctypes.c_size_t]
     lib.sr_free.argtypes = [ctypes.c_void_p]
     lib.sr_pool_create.restype = ctypes.c_void_p
+    lib.sr_pool_create.argtypes = []
     lib.sr_pool_destroy.argtypes = [ctypes.c_void_p]
     lib.sr_pool_get.restype = ctypes.c_void_p
     lib.sr_pool_get.argtypes = [ctypes.c_void_p, ctypes.c_size_t]

@@ -203,8 +203,19 @@ class TieredStore:
                         poke=lambda: self._wq.put(("tenant", tenant)))
         seg = _Segment(key, arr.shape, arr.dtype, arr.nbytes,
                        tenant=tenant, shuffle=shuffle)
-        lease = self.host_pool.get(arr.nbytes)
-        lease.view(arr.dtype, arr.shape)[...] = arr
+        lease = None
+        try:
+            lease = self.host_pool.get(arr.nbytes)
+            lease.view(arr.dtype, arr.shape)[...] = arr
+        except BaseException:
+            # the pool refusing (MemoryError) after a successful quota
+            # admission must roll the charge back, or the tenant's
+            # balance leaks bytes that never landed
+            if lease is not None:
+                lease.release()
+            if acct is not None:
+                acct.release("host", arr.nbytes)
+            raise
         seg.lease = lease
         old = None
         old_ev, defer_old, closed = None, False, False
@@ -418,8 +429,18 @@ class TieredStore:
             acct = self._accounts.get(seg.tenant) if seg.tenant else None
         if acct is not None and not acct.try_charge("host", seg.nbytes):
             return False
-        lease = self.host_pool.get(data.nbytes)
-        lease.view(data.dtype, data.shape)[...] = data
+        lease = None
+        try:
+            lease = self.host_pool.get(data.nbytes)
+            lease.view(data.dtype, data.shape)[...] = data
+        except BaseException:
+            # allocation failure must refund the try_charge, or the
+            # tenant's host balance leaks bytes it never got
+            if lease is not None:
+                lease.release()
+            if acct is not None:
+                acct.release("host", seg.nbytes)
+            raise
         stale: Optional[HostBuffer] = None
         with self._lock:
             cur = self._segments.get(key)

@@ -40,6 +40,8 @@ from sparkrdma_tpu.lint import rules_sync     # noqa: F401
 from sparkrdma_tpu.lint import rules_timeline  # noqa: F401
 from sparkrdma_tpu.lint import rules_safety   # noqa: F401
 from sparkrdma_tpu.lint import rules_concurrency  # noqa: F401
+from sparkrdma_tpu.lint import rules_resources  # noqa: F401
+from sparkrdma_tpu.lint import rules_abi      # noqa: F401
 
 __all__ = ["Finding", "LintContext", "Rule", "all_rules", "get_rule",
            "rule", "run_rules"]

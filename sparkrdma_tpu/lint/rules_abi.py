@@ -1,0 +1,514 @@
+"""Native-ABI sync rules: the ``extern "C"`` ↔ ctypes bridge, verified.
+
+Two rules share one parsed model (memoized on the
+:class:`~sparkrdma_tpu.lint.core.LintContext`):
+
+- **abi-sync** — a clang-free tokenizer extracts every ``sr_*``
+  function in ``native/staging.cpp``'s ``extern "C"`` block (return
+  type, parameter types, arity) and cross-checks both directions
+  against the ``restype``/``argtypes`` table in
+  ``hbm/host_staging.py``: a C symbol the Python side never declares, a
+  Python declaration with no C definition, an arity drift, a
+  width-inexact type (``size_t`` must be ``c_size_t``, ``long`` must be
+  ``c_long``, ``int64_t`` must be ``c_int64`` — ``c_int`` for any of
+  them truncates on LP64), a missing ``argtypes``, and the classic
+  footgun: a pointer-returning function with no ``restype`` defaults to
+  ``c_int`` and silently truncates 64-bit pointers.
+- **abi-gate** — symbols declared inside a feature-probe ``try``/
+  ``except AttributeError`` block (the ones an older prebuilt ``.so``
+  may lack: gated by ``sr_has_codec`` / ``sr_has_cols``, established
+  via ``sr_codec_abi``) may only be called where the probe dominates
+  the call: a read of the gate flag, or a call to a probe helper (a
+  package function that reads the flag, transitively), earlier in the
+  same function — so a stale library degrades to the numpy path
+  instead of segfaulting.
+
+Both rules skip when their anchor files are absent, which is what makes
+one-rule-at-a-time fixtures possible; unparseable declarations produce
+no findings (conservatism contract: a missed mismatch is a lint gap, an
+invented one poisons the repo-clean meta-test).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from sparkrdma_tpu.lint.core import Finding, LintContext, SourceFile, rule
+
+_CPP_REL = "sparkrdma_tpu/native/staging.cpp"
+_PY_REL = "sparkrdma_tpu/hbm/host_staging.py"
+
+#: C scalar type → the exact ctypes name it must map to (width-exact:
+#: the lint exists precisely to reject "c_int is probably fine")
+_SCALAR_MAP = {
+    "size_t": "c_size_t",
+    "long": "c_long",
+    "int": "c_int",
+    "int64_t": "c_int64",
+    "uint64_t": "c_uint64",
+    "int32_t": "c_int32",
+    "uint32_t": "c_uint32",
+    "double": "c_double",
+    "float": "c_float",
+}
+
+#: C pointee type → the typed-pointer spelling also accepted (besides
+#: the universal c_void_p)
+_POINTER_MAP = {
+    "long": "POINTER(c_long)",
+    "int64_t": "POINTER(c_int64)",
+    "uint64_t": "POINTER(c_uint64)",
+    "int32_t": "POINTER(c_int32)",
+    "uint32_t": "POINTER(c_uint32)",
+    "uint8_t": "POINTER(c_uint8)",
+    "double": "POINTER(c_double)",
+}
+
+_FUNC_RE = re.compile(
+    r"(?:^|[;}])\s*([A-Za-z_][A-Za-z0-9_]*(?:\s*\*+)?)\s+"
+    r"(sr_[A-Za-z0-9_]*)\s*\(([^)]*)\)\s*\{", re.S)
+
+
+@dataclasses.dataclass(frozen=True)
+class CFunc:
+    """One ``extern "C"`` function: normalized (base, ptr-depth) types."""
+
+    name: str
+    line: int
+    ret: Tuple[str, int]
+    params: Tuple[Tuple[str, int], ...]
+
+
+def _strip_comments(text: str) -> str:
+    """Remove ``//`` and ``/* */`` comments, preserving line numbers."""
+    text = re.sub(r"//[^\n]*", "", text)
+    return re.sub(r"/\*.*?\*/",
+                  lambda m: "\n" * m.group(0).count("\n"), text, flags=re.S)
+
+
+def _extern_c_region(text: str) -> Tuple[int, str]:
+    """(start line, body text) of the first ``extern "C" { ... }``
+    block, matched by brace counting."""
+    m = re.search(r'extern\s+"C"\s*\{', text)
+    if m is None:
+        return 0, ""
+    depth, start = 1, m.end()
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text.count("\n", 0, start) + 1, text[start:i]
+    return text.count("\n", 0, start) + 1, text[start:]
+
+
+def _parse_ctype(tokens: str) -> Optional[Tuple[str, int]]:
+    """``const void* const*`` → ``("void", 2)``; None when empty."""
+    ptr = tokens.count("*")
+    words = [w for w in re.split(r"[\s*]+", tokens)
+             if w and w not in ("const", "volatile", "struct", "unsigned",
+                                "signed")]
+    if not words:
+        return None
+    return words[0], ptr
+
+
+def parse_extern_c(sf: SourceFile) -> List[CFunc]:
+    text = _strip_comments(sf.text)
+    base_line, region = _extern_c_region(text)
+    out: List[CFunc] = []
+    # collect only depth-0 text of the region so identifiers inside
+    # function bodies can't masquerade as declarations
+    depth, top = 0, []
+    for ch in region:
+        if ch == "{":
+            depth += 1
+            if depth == 1:
+                top.append("{")     # the marker _FUNC_RE anchors on
+            continue
+        if ch == "}":
+            depth -= 1
+            if depth == 0:
+                top.append("}")
+            continue
+        if depth == 0:
+            top.append(ch)
+    flat = "".join(top)
+    for m in _FUNC_RE.finditer(flat):
+        ret = _parse_ctype(m.group(1))
+        if ret is None:
+            continue
+        params: List[Tuple[str, int]] = []
+        plist = m.group(3).strip()
+        if plist and plist != "void":
+            ok = True
+            for p in plist.split(","):
+                # drop the trailing parameter name when present
+                words = re.split(r"[\s*]+", p.strip())
+                tokens = p
+                if len([w for w in words if w and w != "const"]) > 1:
+                    tokens = p[:p.rindex(words[-1])]
+                t = _parse_ctype(tokens)
+                if t is None:
+                    ok = False
+                    break
+                params.append(t)
+            if not ok:
+                continue
+        # line number: count newlines up to the match in the flat text
+        # is wrong (bodies elided) — find the symbol in the real text
+        sym = re.search(r"\b%s\s*\(" % re.escape(m.group(2)), text)
+        line = text.count("\n", 0, sym.start()) + 1 if sym else base_line
+        out.append(CFunc(m.group(2), line, ret, tuple(params)))
+    return out
+
+
+# ---------------------------------------------------------------------
+# python side: the ctypes declaration table
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PyDecl:
+    """restype/argtypes assignments for one ``lib.sr_*`` symbol."""
+
+    name: str
+    line: int
+    restype: Optional[str] = None       # canonical name, "None", or
+    restype_line: int = 0               # None = never assigned
+    argtypes: Optional[List[str]] = None
+    argtypes_line: int = 0
+    unparsed: bool = False              # a value we couldn't evaluate
+
+
+def _canon_ctype(node: ast.AST) -> Optional[str]:
+    """``ctypes.c_long`` / ``c_long`` / ``POINTER(c_long)`` / ``None``
+    → canonical string, else None (unparsable)."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if fname == "POINTER" and len(node.args) == 1:
+            inner = _canon_ctype(node.args[0])
+            if inner is not None:
+                return f"POINTER({inner})"
+    return None
+
+
+def _eval_argtypes(node: ast.AST) -> Optional[List[str]]:
+    """Evaluate the small list algebra the table uses:
+    ``[...]``, ``list + list``, ``list * int``."""
+    if isinstance(node, ast.List):
+        out = []
+        for e in node.elts:
+            c = _canon_ctype(e)
+            if c is None:
+                return None
+            out.append(c)
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _eval_argtypes(node.left)
+        right = _eval_argtypes(node.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        left = _eval_argtypes(node.left)
+        if left is not None and isinstance(node.right, ast.Constant) \
+                and isinstance(node.right.value, int):
+            return left * node.right.value
+        return None
+    return None
+
+
+@dataclasses.dataclass
+class AbiModel:
+    """Parsed C exports + Python declarations + feature-gate map."""
+
+    cfuncs: Dict[str, CFunc]
+    decls: Dict[str, PyDecl]
+    #: gated symbol → gate flag name (``sr_has_codec`` / ...)
+    gates: Dict[str, str]
+    #: gate flag → names of probe helpers (transitive readers)
+    probes: Dict[str, Set[str]]
+    present: bool = True
+
+
+def _build(ctx: LintContext) -> AbiModel:
+    cpp = ctx.file(_CPP_REL)
+    py = ctx.file(_PY_REL)
+    if cpp is None or py is None:
+        return AbiModel({}, {}, {}, {}, present=False)
+    cfuncs = {f.name: f for f in parse_extern_c(cpp)}
+
+    decls: Dict[str, PyDecl] = {}
+    gates: Dict[str, str] = {}
+    for node in ast.walk(py.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        # lib.sr_x.restype / lib.sr_x.argtypes
+        if isinstance(t, ast.Attribute) and t.attr in ("restype",
+                                                       "argtypes") \
+                and isinstance(t.value, ast.Attribute) \
+                and t.value.attr.startswith("sr_"):
+            sym = t.value.attr
+            d = decls.setdefault(sym, PyDecl(sym, node.lineno))
+            if t.attr == "restype":
+                d.restype = _canon_ctype(node.value)
+                d.restype_line = node.lineno
+                if d.restype is None:
+                    d.unparsed = True
+            else:
+                d.argtypes = _eval_argtypes(node.value)
+                d.argtypes_line = node.lineno
+                if d.argtypes is None:
+                    d.unparsed = True
+    # feature gates: a Try whose body sets ``lib.sr_has_X`` and whose
+    # handler catches AttributeError gates every symbol declared (or
+    # probed) inside its body
+    for node in ast.walk(py.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not any(isinstance(h.type, ast.Name)
+                   and h.type.id == "AttributeError"
+                   for h in node.handlers if h.type is not None):
+            continue
+        flag = None
+        for st in node.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Attribute) \
+                    and st.targets[0].attr.startswith("sr_has_"):
+                flag = st.targets[0].attr
+        if flag is None:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr.startswith("sr_") \
+                    and not sub.attr.startswith("sr_has_"):
+                gates.setdefault(sub.attr, flag)
+
+    probes = _probe_helpers(ctx, set(gates.values()))
+    return AbiModel(cfuncs, decls, gates, probes)
+
+
+def _reads_flag(fn_node: ast.AST, flag: str) -> bool:
+    """A *read* of the gate flag — functions that assign it (the
+    ``_declare`` writer) are not probes; counting them would make every
+    ``load_native()`` caller pass the gate vacuously."""
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr == flag:
+                    return False
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Attribute) and n.attr == flag:
+            return True
+        if isinstance(n, ast.Constant) and n.value == flag:
+            return True             # getattr(lib, "sr_has_x", False)
+    return False
+
+
+def _probe_helpers(ctx: LintContext, flags: Set[str]
+                   ) -> Dict[str, Set[str]]:
+    """Package functions that read a gate flag, closed transitively:
+    a function that calls a probe helper is itself a probe helper
+    (``serde.native_codec_available`` → ``host_staging
+    .codec_available`` → ``lib.sr_has_codec``)."""
+    from sparkrdma_tpu.lint.callgraph import build_callgraph
+    cg = build_callgraph(ctx)
+    probes: Dict[str, Set[str]] = {f: set() for f in flags}
+    for flag in flags:
+        for fi in cg.funcs.values():
+            if _reads_flag(fi.node, flag):
+                probes[flag].add(fi.name)
+        for _ in range(3):          # bounded transitive closure
+            grew = False
+            for fi in cg.funcs.values():
+                if fi.name in probes[flag]:
+                    continue
+                for call in (n for n in ast.walk(fi.node)
+                             if isinstance(n, ast.Call)):
+                    f = call.func
+                    callee = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None)
+                    if callee in probes[flag]:
+                        probes[flag].add(fi.name)
+                        grew = True
+                        break
+            if not grew:
+                break
+    return probes
+
+
+def abi_model(ctx: LintContext) -> AbiModel:
+    return ctx.memo("abi-model", _build)
+
+
+def _expected_for(ctype: Tuple[str, int]) -> Set[str]:
+    base, ptr = ctype
+    if ptr == 0:
+        exact = _SCALAR_MAP.get(base)
+        return {exact} if exact else set()
+    if ptr == 1 and base == "char":
+        return {"c_char_p"}
+    allowed = {"c_void_p"}
+    if ptr == 1 and base in _POINTER_MAP:
+        allowed.add(_POINTER_MAP[base])
+    return allowed
+
+
+def _ctype_str(ctype: Tuple[str, int]) -> str:
+    return ctype[0] + "*" * ctype[1]
+
+
+@rule("abi-sync",
+      "the extern \"C\" exports in native/staging.cpp and the ctypes "
+      "restype/argtypes table in hbm/host_staging.py must agree on "
+      "symbols, arity, and exact widths")
+def check_abi_sync(ctx: LintContext) -> List[Finding]:
+    m = abi_model(ctx)
+    if not m.present:
+        return []
+    findings: List[Finding] = []
+
+    def report(line: int, msg: str) -> None:
+        findings.append(Finding("abi-sync", _PY_REL, line, msg))
+
+    for name, cf in sorted(m.cfuncs.items()):
+        d = m.decls.get(name)
+        if d is None:
+            findings.append(Finding(
+                "abi-sync", _CPP_REL, cf.line,
+                f"{name} is exported from staging.cpp but "
+                f"host_staging.py never declares its restype/argtypes "
+                "— calls go through ctypes defaults (everything c_int)"))
+            continue
+        if d.unparsed:
+            continue                # can't judge what we can't evaluate
+        # return type -------------------------------------------------
+        want_ret = _expected_for(cf.ret)
+        if cf.ret == ("void", 0):
+            if d.restype not in (None, "None"):
+                report(d.restype_line or d.line,
+                       f"{name} returns void in C but declares "
+                       f"restype {d.restype} — drop it or set None")
+        elif d.restype is None:
+            hint = (" (a 64-bit pointer truncated to c_int)"
+                    if cf.ret[1] else "")
+            report(d.line,
+                   f"{name} returns {_ctype_str(cf.ret)} in C but has "
+                   f"no restype — ctypes defaults to c_int{hint}")
+        elif want_ret and d.restype not in want_ret:
+            report(d.restype_line,
+                   f"{name} returns {_ctype_str(cf.ret)} in C but "
+                   f"restype is {d.restype} (expected "
+                   f"{' or '.join(sorted(want_ret))})")
+        # arguments ---------------------------------------------------
+        if d.argtypes is None:
+            report(d.line,
+                   f"{name} takes {len(cf.params)} parameter(s) in C "
+                   "but has no argtypes — ctypes applies default "
+                   "conversions with no width checking (declare [] "
+                   "even for zero parameters)")
+            continue
+        if len(d.argtypes) != len(cf.params):
+            report(d.argtypes_line,
+                   f"{name} takes {len(cf.params)} parameter(s) in C "
+                   f"but argtypes lists {len(d.argtypes)}")
+            continue
+        for i, (ct, py) in enumerate(zip(cf.params, d.argtypes)):
+            want = _expected_for(ct)
+            if want and py not in want:
+                report(d.argtypes_line,
+                       f"{name} parameter {i} is {_ctype_str(ct)} in C "
+                       f"but argtypes[{i}] is {py} (expected "
+                       f"{' or '.join(sorted(want))})")
+    for name, d in sorted(m.decls.items()):
+        if name not in m.cfuncs:
+            report(d.line,
+                   f"{name} is declared in host_staging.py but "
+                   "staging.cpp exports no such symbol — stale "
+                   "declaration or a typo that AttributeErrors at load")
+    return findings
+
+
+@rule("abi-gate",
+      "calls to feature-gated native symbols (declared under a "
+      "try/except AttributeError probe) must be dominated by a read of "
+      "the gate flag or a probe helper")
+def check_abi_gate(ctx: LintContext) -> List[Finding]:
+    m = abi_model(ctx)
+    if not m.present or not m.gates:
+        return []
+    findings: List[Finding] = []
+    for sf in ctx.package_files():
+        try:
+            tree = sf.tree
+        except SyntaxError:
+            continue
+        for fn in (n for n in ast.iter_child_nodes(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.ClassDef))):
+            for scope in ([fn] if isinstance(fn, ast.FunctionDef)
+                          else [c for c in fn.body
+                                if isinstance(c, ast.FunctionDef)]):
+                findings.extend(_gate_scan(m, sf, scope))
+    return findings
+
+
+def _gate_scan(m: AbiModel, sf: SourceFile, fn: ast.FunctionDef
+               ) -> List[Finding]:
+    out: List[Finding] = []
+    # probe references, by flag, at their line numbers
+    probe_lines: Dict[str, List[int]] = {f: [] for f in m.probes}
+    for n in ast.walk(fn):
+        for flag, helpers in m.probes.items():
+            if isinstance(n, ast.Attribute) and n.attr == flag:
+                probe_lines[flag].append(n.lineno)
+            elif isinstance(n, ast.Constant) and n.value == flag:
+                probe_lines[flag].append(n.lineno)
+            elif isinstance(n, ast.Call):
+                f = n.func
+                callee = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if callee in helpers:
+                    probe_lines[flag].append(n.lineno)
+    # gated calls must be preceded by a probe, or sit inside a
+    # try/except AttributeError (the _declare pattern)
+    guarded: Set[int] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Try) and any(
+                isinstance(h.type, ast.Name)
+                and h.type.id == "AttributeError"
+                for h in n.handlers if h.type is not None):
+            for sub in ast.walk(n):
+                guarded.add(id(sub))
+    for n in ast.walk(fn):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)):
+            continue
+        sym = n.func.attr
+        flag = m.gates.get(sym)
+        if flag is None or id(n) in guarded:
+            continue
+        if any(ln <= n.lineno for ln in probe_lines.get(flag, ())):
+            continue
+        out.append(Finding(
+            "abi-gate", sf.rel, n.lineno,
+            f"{fn.name} calls {sym} without checking {flag} first — an "
+            "older libsparkstaging.so lacks the symbol and this "
+            "segfaults instead of degrading; guard with the probe "
+            "helper"))
+    return out
+
+
+__all__ = ["AbiModel", "abi_model", "parse_extern_c", "CFunc"]

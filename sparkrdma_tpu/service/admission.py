@@ -99,8 +99,16 @@ class AdmissionController:
                 else:
                     self._cv.wait(timeout=0.2)
         waited_s = time.monotonic() - start
-        self._note_admit(tenant, cost, waited_s)
-        return _Ticket(self, tenant)
+        ticket = _Ticket(self, tenant)
+        try:
+            self._note_admit(tenant, cost, waited_s)
+        except BaseException:
+            # the grant already bumped _active; a metrics/journal
+            # failure here must hand the slot back or the controller
+            # permanently loses concurrency
+            ticket.release()
+            raise
+        return ticket
 
     def _release(self) -> None:
         with self._cv:

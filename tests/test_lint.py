@@ -12,6 +12,7 @@ import json
 import subprocess
 import sys
 import textwrap
+import time
 from pathlib import Path
 
 import pytest
@@ -775,6 +776,448 @@ def test_thread_lifecycle_local_and_inline(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# resource-lifecycle rules
+# ---------------------------------------------------------------------
+
+def test_resource_leak_never_released_lease(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def stage(pool, arr):
+            lease = pool.get(arr.nbytes)
+            lease.view()[...] = arr
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert rules_of(got) == ["resource-leak"]
+    assert "host lease" in got[0].message
+    assert "never released" in got[0].message
+
+
+def test_resource_leak_try_finally_is_clean(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def stage(pool, arr):
+            lease = pool.get(arr.nbytes)
+            try:
+                lease.view()[...] = arr
+            finally:
+                lease.release()
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_resource_leak_with_open_clean_bare_open_fires(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def read_ok(path):
+            with open(path) as fh:
+                return fh.read()
+
+        def read_leaks(path):
+            fh = open(path)
+            data = fh.read()
+            return data
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1 and "file handle fh" in got[0].message
+
+
+def test_resource_leak_ownership_transfer_is_clean(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        class Owner:
+            def grab(self, pool, n):
+                self.lease = pool.get(n)       # stored on self
+
+        def fresh(pool, n):
+            lease = pool.get(n)
+            return lease                       # returned to the caller
+
+        def enqueue(pool, frames, n):
+            lease = pool.get(n)
+            frames.append(lease)               # handed to a container
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_resource_leak_interprocedural_derived_acquirer(tmp_path):
+    """A function that returns a fresh handle transfers the obligation
+    to its caller — the caller must then discharge it."""
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def fresh(pool, n):
+            lease = pool.get(n)
+            return lease
+
+        def caller_leaks(pool, n):
+            h = fresh(pool, n)
+            h.view()
+
+        def caller_ok(pool, n):
+            h = fresh(pool, n)
+            h.view()
+            h.release()
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "caller_leaks" in got[0].message
+
+
+def test_resource_leak_exception_window_between_acquisitions(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def double(pool, n):
+            a = pool.get(n)
+            b = pool.get(n)
+            b.release()
+            a.release()
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "host lease a" in got[0].message and "leaks if" in got[0].message
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def double(pool, n):
+            a = pool.get(n)
+            try:
+                b = pool.get(n)
+            except MemoryError:
+                a.release()
+                raise
+            b.release()
+            a.release()
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_resource_leak_partial_multi_tier_charge(tmp_path):
+    """The tenant-accounting bug class: a second tier's admission can
+    raise QuotaExceededError after the first tier already charged."""
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def multi(acct):
+            acct.charge("host", 100)
+            acct.charge("disk", 100)
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "acct.charge('host', ...)" in got[0].message
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def multi(acct):
+            acct.charge("host", 100)
+            try:
+                acct.charge("disk", 100)
+            except BaseException:
+                acct.release("host", 100)
+                raise
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_resource_leak_charge_then_allocation_window(tmp_path):
+    """The shipped tiered-store shape: quota charged, then the pool
+    allocation fails — the rollback handler is the fix."""
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def put(acct, host_pool, nbytes):
+            acct.charge("host", nbytes)
+            lease = host_pool.get(nbytes)
+            return lease
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "host lease acquisition" in got[0].message
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def put(acct, host_pool, nbytes):
+            acct.charge("host", nbytes)
+            try:
+                lease = host_pool.get(nbytes)
+            except BaseException:
+                acct.release("host", nbytes)
+                raise
+            return lease
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_resource_leak_deleted_release_device(tmp_path):
+    """Acceptance pin: removing the release_device call produces the
+    finding; the balanced version is clean."""
+    balanced = """
+        def round_trip(store, shape, sharding):
+            buf = store.acquire_device(shape, "u32", sharding)
+            buf.block_until_ready()
+            store.release_device(buf, sharding)
+    """
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": balanced})
+    assert run_rules(root, select=["resource-leak"]) == []
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def round_trip(store, shape, sharding):
+            buf = store.acquire_device(shape, "u32", sharding)
+            buf.block_until_ready()
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "device slot buf" in got[0].message
+    assert "never released" in got[0].message
+
+
+def test_resource_leak_admission_ticket(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def read_with(adm, tenant):
+            with adm.admit(tenant):
+                return 1
+
+        def read_manual(adm, tenant):
+            t = adm.admit(tenant)
+            t.release()
+
+        def read_leaks(adm, tenant):
+            t = adm.admit(tenant)
+            return 1
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1
+    assert "admission ticket t" in got[0].message
+
+
+def test_resource_leak_discard_and_suppression(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def warm(pool, n):
+            pool.get(n)
+    """})
+    got = run_rules(root, select=["resource-leak"])
+    assert len(got) == 1 and "discarded" in got[0].message
+    root = repo(tmp_path, {"sparkrdma_tpu/r.py": """
+        def warm(pool, n):
+            # deliberate warm-up allocation, freed at pool close
+            # srlint: ignore[resource-leak]
+            pool.get(n)
+    """})
+    assert run_rules(root, select=["resource-leak"]) == []
+
+
+def test_teardown_completeness_pre_pr11_shape(tmp_path):
+    """Acceptance pin: the generalized rule flags the shipped teardown
+    leak's shape — a service owning a store whose stop() forgets it."""
+    leaky = """
+        class TieredThing:
+            def __init__(self, conf):
+                self._segments = {}
+
+            def close(self):
+                self._segments.clear()
+
+        class Service:
+            def __init__(self, conf):
+                self.store = TieredThing(conf)
+                self.label = str(conf)
+
+            def stop(self):
+                self.label = ""
+    """
+    root = repo(tmp_path, {"sparkrdma_tpu/svc.py": leaky})
+    got = run_rules(root, select=["teardown-completeness"])
+    assert rules_of(got) == ["teardown-completeness"]
+    assert "self.store" in got[0].message
+    assert "TieredThing" in got[0].message
+    root = repo(tmp_path, {"sparkrdma_tpu/svc.py": leaky.replace(
+        'self.label = ""', 'self.label = ""\n                '
+                           'self.store.close()')})
+    assert run_rules(root, select=["teardown-completeness"]) == []
+
+
+def test_teardown_completeness_reachable_helper_and_injection(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/svc.py": """
+        class Journal:
+            def __init__(self, path):
+                self.path = path
+
+            def close(self):
+                pass
+
+        class Indirect:
+            def __init__(self, path, pool):
+                self.journal = Journal(path)
+                self.pool = pool          # injected: injector owns it
+
+            def _teardown(self):
+                self.journal.close()
+
+            def stop(self):
+                self._teardown()
+    """})
+    assert run_rules(root, select=["teardown-completeness"]) == []
+
+
+# ---------------------------------------------------------------------
+# native-ABI sync rules
+# ---------------------------------------------------------------------
+
+_CPP_OK = """
+    // minimal extern block exercising scalars, pointers, and void
+    static int helper(int x) { return x; }
+
+    extern "C" {
+
+    void* sr_pool_create() { return 0; }
+
+    long sr_write_file(const char* path, const void* buf, size_t len) {
+      return (long)len;
+    }
+
+    void sr_pool_stats(void* pool, long* hits) { *hits = 0; }
+
+    }  // extern "C"
+"""
+
+_PY_OK = """
+    import ctypes
+
+    def _declare(lib):
+        lib.sr_pool_create.restype = ctypes.c_void_p
+        lib.sr_pool_create.argtypes = []
+        lib.sr_write_file.restype = ctypes.c_long
+        lib.sr_write_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                      ctypes.c_size_t]
+        lib.sr_pool_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_long)]
+        return lib
+"""
+
+_ABI_FILES = {"sparkrdma_tpu/native/staging.cpp": _CPP_OK,
+              "sparkrdma_tpu/hbm/host_staging.py": _PY_OK}
+
+
+def test_abi_sync_clean_pair(tmp_path):
+    root = repo(tmp_path, dict(_ABI_FILES))
+    assert run_rules(root, select=["abi-sync"]) == []
+
+
+def test_abi_sync_flipped_width(tmp_path):
+    """Acceptance pin: one ctypes width flipped (size_t declared c_int)
+    produces the expected finding."""
+    files = dict(_ABI_FILES)
+    files["sparkrdma_tpu/hbm/host_staging.py"] = _PY_OK.replace(
+        "ctypes.c_size_t", "ctypes.c_int")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1
+    assert "sr_write_file parameter 2 is size_t" in got[0].message
+    assert "c_int" in got[0].message and "c_size_t" in got[0].message
+
+
+def test_abi_sync_missing_restype_on_pointer_return(tmp_path):
+    files = dict(_ABI_FILES)
+    files["sparkrdma_tpu/hbm/host_staging.py"] = _PY_OK.replace(
+        "        lib.sr_pool_create.restype = ctypes.c_void_p\n", "")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1
+    assert "sr_pool_create returns void*" in got[0].message
+    assert "truncated to c_int" in got[0].message
+
+
+def test_abi_sync_arity_and_missing_argtypes(tmp_path):
+    files = dict(_ABI_FILES)
+    files["sparkrdma_tpu/hbm/host_staging.py"] = _PY_OK.replace(
+        " ctypes.c_void_p,\n                                      "
+        "ctypes.c_size_t", " ctypes.c_void_p")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1
+    assert "3 parameter(s) in C but argtypes lists 2" in got[0].message
+    files["sparkrdma_tpu/hbm/host_staging.py"] = _PY_OK.replace(
+        "        lib.sr_pool_create.argtypes = []\n", "")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1 and "has no argtypes" in got[0].message
+
+
+def test_abi_sync_both_directions(tmp_path):
+    files = dict(_ABI_FILES)
+    files["sparkrdma_tpu/hbm/host_staging.py"] = _PY_OK.replace(
+        "        return lib",
+        "        lib.sr_gone.restype = ctypes.c_int\n"
+        "        lib.sr_gone.argtypes = []\n"
+        "        return lib")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1
+    assert "sr_gone" in got[0].message and "no such symbol" \
+        in got[0].message
+    files = dict(_ABI_FILES)
+    files["sparkrdma_tpu/native/staging.cpp"] = _CPP_OK.replace(
+        "}  // extern \"C\"",
+        "int sr_extra(size_t n) { return (int)n; }\n\n    }")
+    got = run_rules(repo(tmp_path, files), select=["abi-sync"])
+    assert len(got) == 1
+    assert "sr_extra" in got[0].message
+    assert "never declares" in got[0].message
+
+
+def test_abi_sync_skips_when_anchors_absent(tmp_path):
+    root = repo(tmp_path, {"sparkrdma_tpu/other.py": "X = 1\n"})
+    assert run_rules(root, select=["abi-sync"]) == []
+
+
+_PY_GATED = """
+    import ctypes
+
+    def _declare(lib):
+        lib.sr_pool_create.restype = ctypes.c_void_p
+        lib.sr_pool_create.argtypes = []
+        try:
+            lib.sr_encode_rows.restype = ctypes.c_long
+            lib.sr_encode_rows.argtypes = [ctypes.c_void_p]
+            lib.sr_has_codec = True
+        except AttributeError:
+            lib.sr_has_codec = False
+        return lib
+
+    def codec_available(lib):
+        return bool(getattr(lib, "sr_has_codec", False))
+"""
+
+
+def test_abi_gate_unprobed_call_fires(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/native/staging.cpp": _CPP_OK,
+        "sparkrdma_tpu/hbm/host_staging.py": _PY_GATED,
+        "sparkrdma_tpu/user.py": """
+            def encode(lib, data):
+                return lib.sr_encode_rows(data)
+        """})
+    got = run_rules(root, select=["abi-gate"])
+    assert rules_of(got) == ["abi-gate"]
+    assert "sr_encode_rows" in got[0].message
+    assert "sr_has_codec" in got[0].message
+
+
+def test_abi_gate_probe_helper_and_flag_read_dominate(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/native/staging.cpp": _CPP_OK,
+        "sparkrdma_tpu/hbm/host_staging.py": _PY_GATED,
+        "sparkrdma_tpu/user.py": """
+            def via_helper(lib, data):
+                if codec_available(lib):
+                    return lib.sr_encode_rows(data)
+                return None
+
+            def via_flag(lib, data):
+                if getattr(lib, "sr_has_codec", False):
+                    return lib.sr_encode_rows(data)
+                return None
+
+            def via_wrapper(lib, data):
+                # a helper-of-the-helper still counts (transitive)
+                if native_ready(lib):
+                    return lib.sr_encode_rows(data)
+                return None
+
+            def native_ready(lib):
+                return codec_available(lib)
+        """})
+    assert run_rules(root, select=["abi-gate"]) == []
+
+
+def test_abi_gate_ungated_symbols_need_no_probe(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/native/staging.cpp": _CPP_OK,
+        "sparkrdma_tpu/hbm/host_staging.py": _PY_GATED,
+        "sparkrdma_tpu/user.py": """
+            def make_pool(lib):
+                return lib.sr_pool_create()
+        """})
+    assert run_rules(root, select=["abi-gate"]) == []
+
+
+# ---------------------------------------------------------------------
 # engine: crash reporting, unknown rules, rendering
 # ---------------------------------------------------------------------
 
@@ -822,6 +1265,9 @@ def test_cli_select_json_and_exit_codes(tmp_path):
     payload = json.loads(res.stdout)
     assert payload["rules"] == ["assert-safety"]
     assert [f["rule"] for f in payload["findings"]] == ["assert-safety"]
+    from sparkrdma_tpu.lint import get_rule
+    assert all(f["kind"] == get_rule(f["rule"]).kind
+               for f in payload["findings"])
     res = subprocess.run(cli + ["--select", "no-such-rule"],
                          capture_output=True, text=True, timeout=120)
     assert res.returncode == 2 and "unknown rule" in res.stderr
@@ -829,6 +1275,45 @@ def test_cli_select_json_and_exit_codes(tmp_path):
                          capture_output=True, text=True, timeout=120)
     assert res.returncode == 0
     assert len(res.stdout.strip().splitlines()) >= 10
+
+
+@pytest.mark.slow
+def test_cli_changed_mode(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/a.py": "assert True\n",
+        "sparkrdma_tpu/b.py": "X = 1\n",
+    })
+    git = ["git", "-C", str(root), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(git + ["init", "-q"], check=True, timeout=60)
+    subprocess.run(git + ["add", "-A"], check=True, timeout=60)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True,
+                   timeout=60)
+    cli = [sys.executable, str(REPO / "scripts" / "srlint.py"),
+           "--root", str(root), "--select", "assert-safety"]
+    # a clean tree short-circuits to success
+    res = subprocess.run(cli + ["--changed"], capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 0 and "no changed files" in res.stdout
+    # touching only the clean file filters the a.py finding out
+    (root / "sparkrdma_tpu/b.py").write_text("X = 2\n")
+    res = subprocess.run(cli + ["--changed"], capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    # touching the flagged file surfaces its finding again
+    (root / "sparkrdma_tpu/a.py").write_text("assert True  # still\n")
+    res = subprocess.run(cli + ["--changed"], capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 1
+    assert "sparkrdma_tpu/a.py" in res.stdout
+    # a git range works the same way
+    res = subprocess.run(cli + ["--changed", "HEAD"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1
+    # exit 2 when the range is garbage, matching usage-error convention
+    res = subprocess.run(cli + ["--changed", "no..such..range"],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 2
 
 
 @pytest.mark.slow
@@ -855,6 +1340,16 @@ def test_cli_dot_export(tmp_path):
 
 def test_real_repo_is_srlint_clean():
     """The meta-test: the repo must stay clean under its own linter —
-    every rule, zero findings (modulo in-source suppressions)."""
+    every rule, zero findings (modulo in-source suppressions) — and the
+    full run must fit the tier-1 preamble's wall-clock budget."""
+    from sparkrdma_tpu.lint import all_rules
+    assert len(all_rules()) == 19, \
+        "rule count drifted — update this pin, the README table, and " \
+        "COVERAGE.md together"
+    t0 = time.perf_counter()
     findings = run_rules(REPO)
+    wall = time.perf_counter() - t0
     assert findings == [], "\n".join(f.render() for f in findings)
+    assert wall < 10.0, (
+        f"full srlint run took {wall:.1f}s — the 10s budget keeps the "
+        "tier-1 preamble honest; memoize new analyses on the context")

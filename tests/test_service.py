@@ -138,6 +138,33 @@ def test_oversubscribed_tenant_queues_not_fails(tmp_path, rng):
     assert beats and {"alice", "bob"} <= set(beats[-1]["tenants"])
 
 
+def test_admit_releases_slot_when_note_admit_fails(monkeypatch):
+    """A journal/metrics crash in post-admission bookkeeping must hand
+    the concurrency slot back — otherwise the controller permanently
+    loses a slot and later reads time out for no visible reason."""
+    from sparkrdma_tpu.service.admission import AdmissionController
+
+    ac = AdmissionController(max_concurrent=1, wait_s=1.0)
+    real = ac._note_admit
+    calls = {"n": 0}
+
+    def flaky(tenant, cost, waited_s):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("journal disk full")
+        real(tenant, cost, waited_s)
+
+    monkeypatch.setattr(ac, "_note_admit", flaky)
+    with pytest.raises(RuntimeError):
+        ac.admit("t")
+    assert ac.stats()["active"] == 0       # the failed admit left no slot
+    # the slot is genuinely reusable: this would AdmissionTimeout if the
+    # first admit had stranded _active at 1
+    with ac.admit("t"):
+        assert ac.stats()["active"] == 1
+    assert ac.stats()["active"] == 0
+
+
 def test_tenant_usage_invariants_under_random_ops(tmp_path):
     """Property test: under seeded random multi-tenant store ops, no
     tenant's host/disk ledger ever exceeds its quota, and once the

@@ -227,6 +227,75 @@ def test_sync_fetch_counted_without_prefetch(tmp_path, rng):
 
 
 # ----------------------------------------------------------------------
+# quota-charge rollback on allocation failure (srlint resource-leak fixes)
+# ----------------------------------------------------------------------
+
+def test_put_rolls_back_charge_when_pool_refuses(tmp_path, rng,
+                                                 monkeypatch):
+    """A pool allocation failure AFTER the blocking quota admission must
+    refund the tenant's host charge, or the balance leaks bytes that
+    never landed and the tenant eventually deadlocks against its own
+    phantom usage."""
+    from sparkrdma_tpu.service import TenantAccount, TenantQuota
+
+    store = TieredStore(_conf(tmp_path, 1 << 20))
+    try:
+        acct = TenantAccount("t", TenantQuota(host_bytes=1 << 20))
+        store.register_account("t", acct)
+        a = _arr(rng, 4096)
+
+        def refuse(nbytes):
+            raise MemoryError("pool exhausted")
+
+        monkeypatch.setattr(store.host_pool, "get", refuse)
+        with pytest.raises(MemoryError):
+            store.put("k", a, tenant="t")
+        assert acct.usage()["host"] == 0     # charge rolled back
+        monkeypatch.undo()
+        # the same put succeeds once the pool recovers — no residue
+        store.put("k", a, tenant="t")
+        assert acct.usage()["host"] == 4096
+        np.testing.assert_array_equal(store.get("k"), a)
+    finally:
+        store.close(delete_disk=True)
+
+
+def test_promote_rolls_back_try_charge_when_pool_refuses(tmp_path, rng,
+                                                         monkeypatch):
+    """Promotion's ``try_charge`` must be refunded when the host pool
+    then refuses the lease: the segment stays on disk and the tenant's
+    host balance stays zero instead of leaking the declined bytes."""
+    from sparkrdma_tpu.service import TenantAccount, TenantQuota
+
+    store = TieredStore(_conf(tmp_path, 4096, prefetch=0))
+    try:
+        acct = TenantAccount("t", TenantQuota(host_bytes=1 << 20,
+                                              disk_bytes=1 << 20))
+        store.register_account("t", acct)
+        a = _arr(rng, 8192)
+        store.put("k", a, tenant="t")
+        store.drain()                        # eviction moves it to disk
+        assert store.tier_of("k") == "disk"
+        assert acct.usage()["host"] == 0
+        assert acct.usage()["disk"] == 8192
+
+        def refuse(nbytes):
+            raise MemoryError("pool exhausted")
+
+        monkeypatch.setattr(store.host_pool, "get", refuse)
+        with pytest.raises(MemoryError):
+            store.get("k")                   # sync fetch -> promote
+        assert acct.usage()["host"] == 0     # try_charge refunded
+        assert acct.usage()["disk"] == 8192  # disk side untouched
+        monkeypatch.undo()
+        np.testing.assert_array_equal(store.get("k"), a)
+        assert acct.usage()["host"] == 8192  # promotion now lands
+        assert acct.usage()["disk"] == 0
+    finally:
+        store.close(delete_disk=True)
+
+
+# ----------------------------------------------------------------------
 # segment-level checkpoint resume + end-to-end bit-equality
 # ----------------------------------------------------------------------
 
