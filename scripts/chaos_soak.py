@@ -34,12 +34,22 @@ seed replays exactly.
    injected-fault events and no degradations — the noisy tenant's
    chaos stays inside its own session plane.
 
+4. **Map-side combine under fire** — a duplicate-heavy
+   ``reduce_by_key`` with the pre-exchange combine pass forced ON runs
+   under transient faults at ``exchange.dispatch`` (and, when the
+   native codec is built, ``serde.encode``); its output must match a
+   fault-free ``map_side_combine="off"`` control bit for bit. The
+   uint32 "sum" aggregator is associative mod 2**32, so combine is a
+   pure wire-size optimization — retries that replay a combined
+   dispatch must never change what the reader aggregates to.
+
 Usage (CPU host, 8 simulated devices)::
 
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py --seed 7
 
-Exit 0: all legs bit-identical, >= 6 sites hit, books balanced, and
-the two-tenant leg's clean tenant untouched by the noisy one's faults.
+Exit 0: all legs bit-identical, >= 6 sites hit, books balanced, the
+two-tenant leg's clean tenant untouched by the noisy one's faults, and
+the combine-on chaos leg bitwise equal to its combine-off control.
 Prints one JSON summary line (plus per-leg progress on stderr).
 """
 
@@ -280,6 +290,85 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
     }
 
 
+def run_combine_leg(args, common: dict, tmp: str) -> dict:
+    """Map-side combine vs combine-off control, chaos on the combined side.
+
+    Same seeded duplicate-heavy data twice: a fault-free control with
+    ``map_side_combine="off"``, then a chaos pass with the combine pass
+    forced ON under transient faults at ``exchange.dispatch`` and (when
+    the native codec is built) ``serde.encode`` — the rows are built
+    through ``encode_bytes_rows`` precisely so the encode site sits on
+    this leg's path. Verdict fields:
+
+    - ``identical``: combined chaos output == uncombined control, bitwise
+    - ``combined``: the chaos pass really shipped fewer bytes (its
+      ``combine_out_bytes`` is non-zero and below ``combine_in_bytes``)
+      while the control shipped uncombined (``combine_out_bytes == 0``)
+    - ``wire_reduction_ratio`` / ``sites_hit``: evidence for the report
+    """
+    import numpy as np
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf, faults
+    from sparkrdma_tpu.api import serde
+    from sparkrdma_tpu.api.dataset import Dataset
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+
+    spec = "exchange.dispatch:fail@attempt<2"
+    if serde.native_codec_available():
+        spec += ";serde.encode:fail@attempt<1"
+    rpd = max(args.records_per_device // 2, 256)
+
+    def leg(conf):
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = m.runtime.num_partitions
+            n = mesh * rpd
+            rng = np.random.default_rng(args.seed + 30)
+            keys = np.zeros((n, 2), dtype=np.uint32)
+            keys[:, 1] = rng.integers(0, max(n // 16, 4), size=n,
+                                      dtype=np.uint32)
+            vals = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+            payloads = [int(v).to_bytes(4, "little") for v in vals]
+            rows = serde.encode_bytes_rows(keys, payloads, 24)
+            ds = Dataset.from_host_rows(m, rows).reduce_by_key("sum")
+            out = ds.to_host_rows().copy()
+            ws = m._exchange.wire_stats()
+            return out, ws, sorted(m.faults.sites_hit())
+        finally:
+            m.stop()
+
+    conf_off = ShuffleConf(spill_dir=os.path.join(tmp, "cmb_ctl"),
+                           map_side_combine="off", **common)
+    control, ws_off, _ = leg(conf_off)
+
+    faults.reset_accounting()
+    conf_on = ShuffleConf(spill_dir=os.path.join(tmp, "cmb_chaos"),
+                          map_side_combine="on", fault_spec=spec,
+                          **common)
+    chaos, ws_on, sites = leg(conf_on)
+    serde._reset_native_degrade()
+
+    identical = outputs_equal(control, chaos)
+    in_b = int(ws_on.get("combine_in_bytes", 0))
+    out_b = int(ws_on.get("combine_out_bytes", 0))
+    # combine-off wire stats carry no combine_* byte keys at all — the
+    # control must not have combined
+    combined = (0 < out_b < in_b
+                and int(ws_off.get("combine_out_bytes", 0)) == 0)
+    ratio = round(in_b / out_b, 3) if out_b else None
+    ok = identical and combined and "exchange.dispatch" in sites
+    return {
+        "ok": ok,
+        "identical": identical,
+        "combined": combined,
+        "unique_rows": int(chaos.shape[0]),
+        "combine_in_bytes": in_b,
+        "combine_out_bytes": out_b,
+        "wire_reduction_ratio": ratio,
+        "sites_hit": sites,
+    }
+
+
 def outputs_equal(a, b) -> bool:
     import numpy as np
 
@@ -392,11 +481,18 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         tenant_leg = run_two_tenant_leg(args, common, tmp)
 
+        # --- map-side combine pass (fresh accounting) ------------------
+        faults.reset_accounting()
+        print("combine pass: forced map-side combine under faults...",
+              file=sys.stderr, flush=True)
+        combine_leg = run_combine_leg(args, common, tmp)
+
     identical = {leg: outputs_equal(control[leg], chaos[leg])
                  for leg in control}
     sites = plane.sites_hit()
     ok = (all(identical.values()) and len(sites) >= 6 and books
-          and not spans_missing_backoff and tenant_leg["ok"])
+          and not spans_missing_backoff and tenant_leg["ok"]
+          and combine_leg["ok"])
 
     print(json.dumps({
         "ok": ok,
@@ -413,6 +509,7 @@ def main(argv=None) -> int:
         "spans_missing_backoff": spans_missing_backoff,
         "bit_identical": identical,
         "tenant_leg": tenant_leg,
+        "combine_leg": combine_leg,
     }, default=str))
     return 0 if ok else 1
 

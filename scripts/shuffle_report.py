@@ -24,8 +24,13 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
   spans/records/bytes per tenant, exact rollup totals, admission-wait
   counts from the fair-queueing ``admission`` lines, and the latest
   heartbeat's per-tenant tier usage;
+- wire reduction (schema v9): bytes the map-side combine pass and the
+  predicate/projection pushdown kept OFF the fabric, summed over the
+  per-span ``combine_*`` / ``pushdown_*`` fields, with the measured
+  pre/post-combine ratio;
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
-  stalls, retries) to the ShuffleConf knob that addresses them.
+  stalls, retries, combinable-but-uncombined shuffles) to the
+  ShuffleConf knob that addresses them.
 
 Rotated journals (``j.jsonl.1``, ``.2``, … from
 ``ShuffleConf.journal_max_bytes``) are walked automatically — pass the
@@ -254,6 +259,27 @@ def aggregate(spans: List[dict]) -> dict:
                             max(enc_s - c_enc_s, 0.0),
                             max(dec_b - c_dec_b, 0.0),
                             max(dec_s - c_dec_s, 0.0))
+    # wire reduction (schema v9): the combine/pushdown fields are
+    # PER-SPAN values, so straight sums are the journal's totals
+    c_in_b = sum(int(s.get("combine_in_bytes", 0) or 0) for s in spans)
+    c_out_b = sum(int(s.get("combine_out_bytes", 0) or 0) for s in spans)
+    wire = {
+        "combine_in_records": sum(
+            int(s.get("combine_in_records", 0) or 0) for s in spans),
+        "combine_out_records": sum(
+            int(s.get("combine_out_records", 0) or 0) for s in spans),
+        "combine_in_bytes": c_in_b,
+        "combine_out_bytes": c_out_b,
+        "combine_reduction_ratio": (round(c_in_b / c_out_b, 3)
+                                    if c_out_b > 0 else None),
+        "max_dup_ratio": round(max(
+            (float(s.get("combine_dup_ratio", 0.0) or 0.0)
+             for s in spans), default=0.0), 4),
+        "pushdown_rows_dropped": sum(
+            int(s.get("pushdown_rows_dropped", 0) or 0) for s in spans),
+        "pushdown_words_dropped": sum(
+            int(s.get("pushdown_words_dropped", 0) or 0) for s in spans),
+    }
     st_spill = sum(v[0] for v in store_by_host.values())
     st_fetch = sum(v[1] for v in store_by_host.values())
     st_hits = sum(v[2] for v in store_by_host.values())
@@ -287,6 +313,7 @@ def aggregate(spans: List[dict]) -> dict:
         "spill_count": spills,
         "serde": serde,
         "store": store,
+        "wire": wire,
         "phases": {k: round(v, 6) for k, v in phases.items()},
         "phase_share": {
             k: round(v / wall, 4) if wall > 0 else 0.0
@@ -525,6 +552,11 @@ def host_breakdown(spans: List[dict]) -> dict:
 #: skew-split planner's own intervention threshold territory
 DOCTOR_SKEW_THRESHOLD = 4.0
 
+#: sampled key-duplication past this ratio means at least half the
+#: shuffled records share a key with another record on the same device —
+#: a map-side combine would collapse them before they hit the fabric
+DOCTOR_DUP_RATIO_THRESHOLD = 0.5
+
 
 def _sync_fetch_shuffles(spans: List[dict]) -> Dict[int, int]:
     """Shuffle ids whose exchanges blocked on synchronous tiered-store
@@ -564,6 +596,25 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             f"{skewed}: partition sizes are unbalanced — try "
             'ShuffleConf(geometry_classes="fine") so slot classes track '
             "actual partition sizes, or a better-spreading partitioner")
+    # high key-duplication shuffles running WITHOUT map-side combine:
+    # the gate journals the sampled duplicate ratio even when combine is
+    # off, so the symptom is visible from the journal alone
+    dup_spans = [s for s in spans
+                 if float(s.get("combine_dup_ratio", 0.0) or 0.0)
+                 >= DOCTOR_DUP_RATIO_THRESHOLD
+                 and not int(s.get("combine_out_bytes", 0) or 0)]
+    if dup_spans:
+        uncombined = sorted({int(s.get("shuffle_id", -1))
+                             for s in dup_spans})
+        worst_dup = max(float(s.get("combine_dup_ratio", 0.0) or 0.0)
+                        for s in dup_spans)
+        findings.append(
+            f"key duplication up to {worst_dup:.0%} in shuffle(s) "
+            f"{uncombined} shipped WITHOUT map-side combine: most of "
+            "those bytes would collapse before the fabric — set "
+            'ShuffleConf(map_side_combine="on") (or lower '
+            "combine_min_dup_ratio if the auto gate skipped it), and "
+            "check the degradation list for a combine fallback")
     spills = max((int(s.get("spill_count", 0)) for s in spans), default=0)
     if spills > 0:
         findings.append(
@@ -658,6 +709,10 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
             "transport": "configured transport failed to construct; "
                          "running on the plain xla all_to_all — check "
                          "the ring/hierarchical prerequisites",
+            "combine": "map-side combine program failed to construct; "
+                       "shuffles ship uncombined (correct, more wire "
+                       "bytes) — check the journaled reason and the "
+                       "aggregator/geometry combination",
         }
         detail = "; ".join(f"{d}: {hints.get(d, 'see faults.py ladder')}"
                            for d in degraded)
@@ -753,6 +808,26 @@ def print_report(rep: dict, top: int) -> None:
         print(f"  fabric delivered rate over the same spans: "
               f"{sd['fabric_mbps']:,.1f} MB/s "
               f"({_bound_verdict(sd)})")
+    wr = rep.get("wire") or {}
+    if wr.get("combine_out_bytes") or wr.get("pushdown_rows_dropped") \
+            or wr.get("pushdown_words_dropped"):
+        print("wire reduction (pre-exchange combine + pushdown):")
+        if wr.get("combine_out_bytes"):
+            saved = wr["combine_in_bytes"] - wr["combine_out_bytes"]
+            print(f"  map-side combine: {wr['combine_in_records']:,} -> "
+                  f"{wr['combine_out_records']:,} records, "
+                  f"{_fmt_bytes(wr['combine_in_bytes'])} -> "
+                  f"{_fmt_bytes(wr['combine_out_bytes'])} "
+                  f"({wr['combine_reduction_ratio']:.2f}x, "
+                  f"{_fmt_bytes(saved)} kept off the fabric)")
+        if wr.get("pushdown_rows_dropped"):
+            print(f"  predicate pushdown: "
+                  f"{wr['pushdown_rows_dropped']:,} rows dropped "
+                  "before bucketing")
+        if wr.get("pushdown_words_dropped"):
+            print(f"  projection pushdown: "
+                  f"{wr['pushdown_words_dropped']:,} payload words "
+                  "off the wire")
     st = rep.get("store") or {}
     if st.get("spill_bytes") or st.get("fetch_bytes"):
         hits = st.get("prefetch_hit_rate")

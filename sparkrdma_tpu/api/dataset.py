@@ -291,6 +291,16 @@ class Dataset:
         #: carried through layout-preserving verbs so decode can return
         #: columnar views instead of per-row pickle materialization
         self.schema = schema
+        #: LOGICAL pending ops (predicate / projection pushdown): set by
+        #: :meth:`filter` / :meth:`select`, consumed by the NEXT
+        #: :meth:`_exchange` (fused into the exchange program so dropped
+        #: rows/words never hit the wire) or by
+        #: :meth:`_materialize_pending` for host-side exits
+        self._pending_filter: Optional[Callable] = None
+        self._pending_select: Optional[Tuple[str, ...]] = None
+        #: live column set after a projection ran (None = all columns);
+        #: projected-away columns decode as zeros / empty bytes
+        self.projected: Optional[Tuple[str, ...]] = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -441,6 +451,10 @@ class Dataset:
         of a list of bytes — no ``pickle.loads`` at all, so a decode ->
         re-encode round trip never builds a Python object per row. It
         compares and iterates like a list of bytes."""
+        if self._pending_filter is not None or \
+                self._pending_select is not None:
+            return self._materialize_pending().to_host_payloads(
+                overlap=overlap)
         from sparkrdma_tpu.api.pipeline import (decode_cols_from_device,
                                                 decode_rows_from_device)
 
@@ -468,6 +482,10 @@ class Dataset:
         :class:`~sparkrdma_tpu.api.serde.BytesColumn`. Requires a
         schema (declared at load time or attached via
         :meth:`from_host_rows`)."""
+        if self._pending_filter is not None or \
+                self._pending_select is not None:
+            return self._materialize_pending().to_host_columns(
+                overlap=overlap)
         from sparkrdma_tpu.api.pipeline import decode_cols_from_device
 
         if self.schema is None:
@@ -481,7 +499,12 @@ class Dataset:
 
     def to_host_rows(self) -> np.ndarray:
         """Valid records only, concatenated in device order (reserved
-        null-key filler rows filtered out)."""
+        null-key filler rows filtered out). Pending :meth:`filter` /
+        :meth:`select` ops apply eagerly here — a host exit is a
+        consumer just like an exchange."""
+        if self._pending_filter is not None or \
+                self._pending_select is not None:
+            return self._materialize_pending().to_host_rows()
         mesh = self.manager.runtime.num_partitions
         cap = self.records.shape[1] // mesh
         cols = np.asarray(self.records)
@@ -498,6 +521,10 @@ class Dataset:
         """Valid, non-filler record count — one compiled per-device
         reduction (a [mesh]-int device-to-host read, never the full
         dataset)."""
+        if self._pending_filter is not None:
+            # a pending select never changes the row count; a pending
+            # filter does, so materialize it first
+            return self._materialize_pending().count
         m = self.manager
         mesh = m.runtime.num_partitions
         cap = self.records.shape[1] // mesh
@@ -532,6 +559,14 @@ class Dataset:
                   aggregator: Optional[str] = None,
                   float_payload: bool = False) -> "Dataset":
         m = self.manager
+        # consume pending logical ops: they fuse into the exchange
+        # program (filtered rows never occupy a round slot; projected
+        # words come off the wire width) instead of materializing here
+        row_filter = self._pending_filter
+        sel = self._pending_select
+        keep_words = None
+        if sel is not None:
+            keep_words = self.schema.keep_words(sel, m.conf.key_words)
         # skip ids the user already registered explicitly on this manager
         # (documented separation, now enforced): the registry raises the
         # dedicated duplicate-id error, so draw until one sticks — any
@@ -550,14 +585,20 @@ class Dataset:
             m.get_writer(handle).write(self._dense_records()).stop(True)
             out, totals = m.get_reader(
                 handle, key_ordering=key_ordering, aggregator=aggregator,
-                float_payload=float_payload).read()
+                float_payload=float_payload, row_filter=row_filter,
+                keep_words=keep_words).read()
             # detach from the pool before unregister releases the buffer
             # (schema survives layout-preserving exchanges; an
             # aggregator rewrites payload words, so the layout claim no
             # longer holds and the schema is dropped)
-            return Dataset(m, jnp.array(out), jnp.array(totals),
-                           schema=self.schema if aggregator is None
-                           else None)
+            res = Dataset(m, jnp.array(out), jnp.array(totals),
+                          schema=self.schema if aggregator is None
+                          else None)
+            if sel is not None and aggregator is None:
+                # record the live column set: projected-away columns are
+                # physically zero in the result and decode as 0 / b""
+                res.projected = sel
+            return res
         finally:
             m.unregister_shuffle(sid)
 
@@ -617,9 +658,131 @@ class Dataset:
             cache[ck] = fn
         return fn(self.records, self.totals)
 
+    def _materialize_pending(self) -> "Dataset":
+        """Eagerly apply pending :meth:`filter` / :meth:`select` ops in
+        ONE compiled per-device pass — the escape hatch for consumers
+        that cannot fuse them (host exits, verbs that rewrite payload
+        words before their shuffle). Filtered-out rows become reserved
+        null-key filler (every downstream verb already excludes those);
+        projected-away payload words zero out, matching the re-widened
+        wire semantics of the fused path bit for bit."""
+        pred = self._pending_filter
+        sel = self._pending_select
+        if pred is None and sel is None:
+            return self
+        m = self.manager
+        mesh = m.runtime.num_partitions
+        cap = self.records.shape[1] // mesh
+        w = self.records.shape[0]
+        kw = m.conf.key_words
+        keep_words = (self.schema.keep_words(sel, kw)
+                      if sel is not None else None)
+        fkey = (getattr(pred, "cache_key", None) or id(pred)) \
+            if pred is not None else None
+        cache = _join_programs.setdefault(m, {})
+        ck = ("pending", cap, w, fkey, keep_words)
+        fn = cache.get(ck)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from sparkrdma_tpu.utils.compat import shard_map
+
+            rt = m.runtime
+            ax = rt.axis_name
+            null = jnp.uint32(_NULL)
+            word_live = None
+            if keep_words is not None:
+                lm = np.zeros((w, 1), np.uint32)
+                lm[list(keep_words)] = 1
+                word_live = jnp.asarray(lm)
+
+            def local(r):
+                out = r
+                if pred is not None:
+                    out = jnp.where(pred(r)[None], out, null)
+                if word_live is not None:
+                    out = out * word_live
+                return out
+
+            fn = jax.jit(shard_map(
+                local, mesh=rt.mesh,
+                in_specs=(P(None, ax),),
+                out_specs=P(None, ax),
+            ))
+            cache[ck] = fn
+        res = Dataset(m, fn(self.records), self.totals,
+                      schema=self.schema)
+        if sel is not None:
+            res.projected = sel
+        return res
+
     # ------------------------------------------------------------------
     # the Spark verbs
     # ------------------------------------------------------------------
+    def filter(self, pred: Callable,
+               cache_key: Optional[Tuple] = None) -> "Dataset":
+        """LOGICAL predicate pushdown (rdd.filter, lazy): nothing runs
+        now — the predicate fuses into the next shuffle's exchange
+        program, where dropped rows never occupy a round slot, so the
+        shuffle ships only surviving bytes. Host exits
+        (``to_host_rows``/``count``/...) apply it eagerly instead.
+
+        ``pred`` is a jit-safe function over FULL-width columnar records
+        ``uint32 [W, n] -> bool [n]`` — it may reference payload words a
+        chained :meth:`select` projects away, because the exchange
+        evaluates predicates before projection. Chained filters AND
+        together. ``cache_key`` is a stable hashable identity for the
+        compiled-program caches; without one a fresh lambda per call
+        recompiles the exchange."""
+        if cache_key is not None:
+            pred.cache_key = cache_key
+        prev = self._pending_filter
+        if prev is not None:
+            old, new = prev, pred
+
+            def pred(r, _old=old, _new=new):  # noqa: F811 — composed
+                return _old(r) & _new(r)
+
+            pred.cache_key = ("and",
+                              getattr(old, "cache_key", None) or id(old),
+                              getattr(new, "cache_key", None) or id(new))
+        ds = Dataset(self.manager, self.records, self.totals,
+                     schema=self.schema)
+        ds._pending_filter = pred
+        ds._pending_select = self._pending_select
+        ds.projected = self.projected
+        return ds
+
+    def select(self, *columns: str) -> "Dataset":
+        """LOGICAL projection pushdown (df.select, lazy): keep only the
+        named schema columns. Nothing runs now — the next shuffle ships
+        a narrower record (key words always ride; projected-away payload
+        words come off the effective wire width and are re-widened as
+        zeros on the reader), and host exits zero the dropped words
+        eagerly. Requires a schema-carrying dataset; a chained select
+        must name a subset of the previous selection."""
+        if self.schema is None:
+            raise ValueError(
+                "select needs a schema-carrying dataset — declare a "
+                "RowSchema at load time")
+        names = tuple(columns)
+        if not names:
+            raise ValueError("select needs at least one column name")
+        for n in names:
+            self.schema.column_word_span(n)  # validates the name
+        if self._pending_select is not None:
+            gone = [n for n in names if n not in self._pending_select]
+            if gone:
+                raise ValueError(
+                    f"column(s) {gone} were already projected away by a "
+                    f"previous select({list(self._pending_select)})")
+        ds = Dataset(self.manager, self.records, self.totals,
+                     schema=self.schema)
+        ds._pending_filter = self._pending_filter
+        ds._pending_select = names
+        ds.projected = self.projected
+        return ds
+
     def repartition(self, num_parts: Optional[int] = None) -> "Dataset":
         """Hash-repartition across the mesh (rdd.repartition)."""
         m = self.manager
@@ -632,13 +795,18 @@ class Dataset:
         range partition -> exchange -> fused per-device sort."""
         m = self.manager
         rt = m.runtime
-        records = self._dense_records()
+        # the splitter sample must see post-filter keys, and the fresh
+        # Dataset below would silently drop pending ops — apply them
+        # eagerly first (filtered rows become filler, which the sampler
+        # treats as max-key noise and key_ordering sorts to the tail)
+        base = self._materialize_pending()
+        records = base._dense_records()
         sampler = make_sampler(rt.mesh, rt.axis_name, m.conf.key_words,
                                samples_per_device)
         samples = np.asarray(jax.device_get(sampler(records)))
         splitters = compute_splitters(samples, rt.num_partitions)
         part = range_partitioner(splitters, m.conf.key_words)
-        ds = Dataset(m, records, schema=self.schema)
+        ds = Dataset(m, records, schema=base.schema)
         return ds._exchange(part, rt.num_partitions, key_ordering=True)
 
     def reduce_by_key(self, op: str = "sum",
@@ -730,7 +898,11 @@ class Dataset:
                                        axis=0)
 
             cache[ck] = to_ones
-        counted = Dataset(m, to_ones(self.records), self.totals)
+        # to_ones rewrites payload words, so a pending predicate (which
+        # sees full-width records) must run BEFORE the rewrite — it
+        # cannot fuse into the downstream reduce_by_key exchange
+        base = self._materialize_pending()
+        counted = Dataset(m, to_ones(base.records), base.totals)
         return counted.reduce_by_key("sum")
 
     def _grouping_program(self, cap: int) -> Callable:

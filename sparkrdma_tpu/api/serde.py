@@ -599,6 +599,32 @@ class RowSchema:
     def is_bytes_only(self) -> bool:
         return len(self.fields) == 1 and self.var_name is not None
 
+    def column_word_span(self, name: str) -> Tuple[int, int]:
+        """``(offset, width)`` of a column within the payload region,
+        in words (a bytes column spans its length word + slot words)."""
+        for n, kind, off in self.fixed:
+            if n == name:
+                return off, _FIXED_KINDS[kind][0]
+        if name == self.var_name:
+            return self.var_len_word, 1 + self.var_slot_words
+        raise KeyError(f"schema has no column {name!r} "
+                       f"(columns: {list(self.names)})")
+
+    def keep_words(self, columns: Sequence[str],
+                   key_words: int) -> Tuple[int, ...]:
+        """Absolute wire word indices of a projection keeping only
+        ``columns`` — the ``keep_words`` operand of
+        :meth:`~sparkrdma_tpu.exchange.protocol.ShuffleExchange
+        .exchange`: every key word (always shipped; the exchange
+        requires them) plus each kept column's payload words,
+        ascending. Unknown names raise ``KeyError``; duplicate names
+        collapse."""
+        words = set(range(key_words))
+        for name in columns:
+            off, width = self.column_word_span(name)
+            words.update(range(key_words + off, key_words + off + width))
+        return tuple(sorted(words))
+
     def __eq__(self, other: object) -> bool:
         return isinstance(other, RowSchema) and self.fields == other.fields
 

@@ -167,7 +167,9 @@ class ShuffleReader:
                  end_partition: Optional[int] = None,
                  key_ordering: bool = False,
                  aggregator: Optional[str] = None,
-                 float_payload: bool = False):
+                 float_payload: bool = False,
+                 row_filter: Optional[Callable] = None,
+                 keep_words: Optional[Tuple[int, ...]] = None):
         self._m = manager
         self._h = handle
         self.start_partition = start_partition
@@ -184,9 +186,22 @@ class ShuffleReader:
             raise ValueError(f"unsupported aggregator {aggregator!r}")
         if float_payload and aggregator is None:
             raise ValueError("float_payload requires an aggregator")
+        if (row_filter is not None or keep_words is not None) and \
+                (start_partition, self.end_partition) != (0,
+                                                          handle.num_parts):
+            # the partition-range window math slices the output stream
+            # by the PLAN's pre-filter counts; a pushdown shrinks the
+            # stream underneath those windows, so the combination is
+            # rejected rather than silently mis-sliced
+            raise ValueError(
+                "row_filter/keep_words pushdown requires a full "
+                "partition range (partition-ranged reads slice by the "
+                "plan's pre-filter counts)")
         self.key_ordering = key_ordering
         self.aggregator = aggregator
         self.float_payload = float_payload
+        self.row_filter = row_filter
+        self.keep_words = keep_words
 
     def read(self, record_stats: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Execute the planned exchange; return ``(records, totals)``.
@@ -286,6 +301,8 @@ class ShuffleReader:
                                 aggregator=fuse_agg,
                                 float_payload=(self.float_payload
                                                if fuse_agg else False),
+                                row_filter=self.row_filter,
+                                keep_words=self.keep_words,
                             )
                         if filtered:
                             with Timer() as ts, annotate_span(
@@ -441,6 +458,9 @@ class ShuffleReader:
                     # span's events are relative to this emit (a
                     # sampled-away span still drains — and discards)
                     events=self._m.timeline.drain(),
+                    # schema v9: measured combine/pushdown wire deltas
+                    # of this read's exchange (per-span, not cumulative)
+                    **ex.wire_stats(),
                 )
                 # sampling decides whether the full span lands; the
                 # rollup folds the read either way, so window totals
@@ -741,9 +761,18 @@ class ShuffleManager:
                    end_partition: Optional[int] = None,
                    key_ordering: bool = False,
                    aggregator: Optional[str] = None,
-                   float_payload: bool = False) -> ShuffleReader:
+                   float_payload: bool = False,
+                   row_filter: Optional[Callable] = None,
+                   keep_words: Optional[Tuple[int, ...]] = None
+                   ) -> ShuffleReader:
+        """``row_filter``/``keep_words`` push a predicate / projection
+        into the exchange program itself (full partition range only):
+        filtered rows never occupy a slot, projected-away payload words
+        never hit the wire (they come back zero-filled). See
+        :meth:`ShuffleExchange.exchange`."""
         return ShuffleReader(self, handle, start_partition, end_partition,
-                             key_ordering, aggregator, float_payload)
+                             key_ordering, aggregator, float_payload,
+                             row_filter, keep_words)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self._registry.unregister(shuffle_id)

@@ -117,6 +117,34 @@ class ShuffleConf:
     #: lax.sort under "fine".
     geometry_classes: str = "pow2"
 
+    # --- map-side combine + pushdown (pre-exchange reduction) ---
+    #: map-side combine policy for aggregator shuffles: "auto" (default
+    #: — a cheap sampled duplicate-ratio estimate gates it per shuffle),
+    #: "on" (always pre-combine), "off" (reader-side combine only, the
+    #: pre-PR-15 behaviour). When active, each device sorts its batch by
+    #: (dest partition, key) and segment-reduces duplicates BEFORE
+    #: bucketing, so each (partition, key) pair crosses the fabric once;
+    #: the ragged size-exchange lane already carries the shrunken
+    #: per-destination counts, so no wire-protocol change. Outputs are
+    #: bit-identical with the pass on or off (integer/min/max ops;
+    #: float32 sums reassociate — same caveat as any map-side combiner).
+    map_side_combine: str = "auto"
+    #: rows sampled (host-side, from the first addressable shard) for
+    #: the "auto" gate's duplicate-ratio estimate. 0 = skip sampling and
+    #: treat "auto" as "on" (the estimate is also journaled per span so
+    #: ``--doctor`` can flag high-duplication shuffles running without
+    #: combine).
+    combine_sample_rows: int = 1024
+    #: minimum sampled duplicate ratio (1 - unique/sample, in [0, 1])
+    #: at which the "auto" gate turns combine on — below it the sort +
+    #: segmented scan would cost more than the bytes it saves.
+    combine_min_dup_ratio: float = 0.25
+    #: graceful degradation: when True, a map-side-combine program that
+    #: fails to build falls back to combine-off for the rest of the
+    #: process (sticky, counted as ``degrade.combine``) instead of
+    #: failing the job — the PR-5 ladder's combine rung.
+    combine_fallback: bool = True
+
     # --- reduce-side sort ---
     #: use the Pallas merge-path sort for fused key-ordering when the
     #: geometry allows (power-of-two output >= 2 runs). It orders by the
@@ -393,6 +421,15 @@ class ShuffleConf:
                 f"lane-width tile minimum), got {self.fast_sort_run}")
         if self.hierarchy_hosts < 0:
             raise ValueError("hierarchy_hosts must be >= 0")
+        if self.map_side_combine not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown map_side_combine {self.map_side_combine!r} "
+                "(supported: 'auto', 'on', 'off')")
+        if self.combine_sample_rows < 0:
+            raise ValueError("combine_sample_rows must be >= 0 (0 = "
+                             "no sampling, 'auto' behaves as 'on')")
+        if not 0.0 <= self.combine_min_dup_ratio <= 1.0:
+            raise ValueError("combine_min_dup_ratio must be in [0, 1]")
         if self.wide_sort_min_payload < 0:
             raise ValueError("wide_sort_min_payload must be >= 0")
         if self.wide_sort_ride_words < 0:

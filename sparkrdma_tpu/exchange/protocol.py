@@ -70,6 +70,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sparkrdma_tpu.config import (ShuffleConf, size_class,
                                   size_class_fine)
 from sparkrdma_tpu.kernels.bucketing import (_UNROLL_LIMIT, bucket_records,
+                                             bucket_sorted_counts,
                                              compact_segments,
                                              fill_round_slots,
                                              fill_round_slots_dest_major,
@@ -277,6 +278,14 @@ class ShuffleExchange:
         # instance) falls back to the plain xla all_to_all. Sticky —
         # flapping between transports would thrash the compile cache.
         self._transport_override: Optional[str] = None
+        # combine rung of the same ladder: sticky per-instance
+        # combine-off after a map-side-combine program fails to build
+        self._combine_override = False
+        # wire accounting of the most recent exchange() — the measured
+        # pre/post-combine + pushdown byte deltas the journal spans and
+        # the future AQE loop consume (see wire_stats())
+        self._last_wire: Optional[Tuple] = None
+        self._last_wire_stats: Dict[str, float] = {}
 
     def transport(self) -> str:
         """The transport actually in use (conf choice, or the sticky
@@ -311,6 +320,108 @@ class ShuffleExchange:
         self.metrics.counter("exchange.transport_fallbacks").inc()
         _faults.note_degradation(
             "transport", reason=f"{self.conf.transport}: {exc}")
+
+    def _degrade_combine(self, exc: BaseException) -> None:
+        """Combine rung of the degradation ladder: sticky per-instance
+        combine-off after a map-side-combine program fails to build or
+        trace (mirrors the transport rung — flapping would thrash the
+        compile cache; the reader-side combine still runs, so results
+        are unchanged, only wire bytes grow back)."""
+        from sparkrdma_tpu import faults as _faults
+
+        self._combine_override = True
+        # compiled programs embed the dead combine pass; rebuild on demand
+        self._exec_cache.clear()
+        self.metrics.counter("combine.fallbacks").inc()
+        _faults.note_degradation("combine", reason=str(exc))
+
+    def _sampled_dup_ratio(self, records) -> float:
+        """Duplicate-key ratio estimate (``1 - unique/sample``) from up
+        to ``conf.combine_sample_rows`` leading rows of the first
+        addressable shard — one tiny D2H read, no compiled pass."""
+        k = self.conf.combine_sample_rows
+        if k <= 0:
+            return 1.0           # sampling disabled: assume duplicates
+        kw = self.conf.key_words
+        try:
+            shard = records.addressable_shards[0].data
+        except (AttributeError, IndexError):
+            shard = records
+        sample = np.asarray(jax.device_get(shard[:kw, :k]))
+        n = sample.shape[1]
+        if n == 0:
+            return 0.0
+        uniq = len({tuple(col) for col in sample.T.tolist()})
+        return 1.0 - uniq / n
+
+    def _combine_gate(self, records, aggregator: str) -> Tuple[bool, float]:
+        """The plan-time combine gate: decide map-side combine for this
+        exchange from the sampled duplicate-ratio estimate.
+
+        The estimate is computed whenever an aggregator is present —
+        even with combine off — so every aggregator span journals the
+        duplication signal ``shuffle_report --doctor``'s missed-combine
+        rule reads."""
+        if not aggregator:
+            return False, 0.0
+        ratio = self._sampled_dup_ratio(records)
+        mode = self.conf.map_side_combine
+        if mode == "off" or self._combine_override:
+            use = False
+        elif mode == "on":
+            use = True
+        else:
+            use = ratio >= self.conf.combine_min_dup_ratio
+        self.metrics.counter(
+            "combine.gate_on" if use else "combine.gate_off").inc()
+        return use, ratio
+
+    def _note_wire(self, records, incoming, combined: bool,
+                   filtered: bool, keep_words, dup_ratio: float) -> None:
+        """Stash the raw operands of :meth:`wire_stats` — summing
+        ``incoming`` syncs with the device, so it is deferred until a
+        span is actually emitted."""
+        w = records.shape[0]
+        w_eff = len(keep_words) if keep_words is not None else w
+        self._last_wire_stats = {}
+        self._last_wire = (int(records.shape[1]), w, w_eff, incoming,
+                           bool(combined), bool(filtered),
+                           float(dup_ratio))
+
+    def wire_stats(self) -> Dict[str, float]:
+        """Combine/pushdown wire accounting of the most recent
+        :meth:`exchange` — the journal span's schema-v9 fields.
+
+        ``combine_{in,out}_{records,bytes}`` measure the pre-exchange
+        reduction (populated only when map-side combine ran; a filter
+        pushdown running under combine is folded into the same delta).
+        ``pushdown_rows_dropped`` counts filter-dropped rows when
+        combine did NOT run; ``pushdown_words_dropped`` counts
+        projected-away payload words actually kept off the wire.
+        ``combine_dup_ratio`` is the gate's sampled estimate (present
+        for every aggregator exchange, combine on or off — the
+        ``--doctor`` missed-combine signal)."""
+        if self._last_wire is None:
+            return {}
+        if self._last_wire_stats:
+            return self._last_wire_stats
+        n_in, w, w_eff, incoming, combined, filtered, ratio = \
+            self._last_wire
+        out_rec = n_in
+        if combined or filtered:
+            out_rec = int(np.asarray(jax.device_get(incoming)).sum())
+        s: Dict[str, float] = {"combine_dup_ratio": ratio}
+        if combined:
+            s.update(combine_in_records=n_in,
+                     combine_out_records=out_rec,
+                     combine_in_bytes=n_in * w * 4,
+                     combine_out_bytes=out_rec * w_eff * 4)
+        elif filtered:
+            s["pushdown_rows_dropped"] = n_in - out_rec
+        if w_eff != w:
+            s["pushdown_words_dropped"] = (w - w_eff) * out_rec
+        self._last_wire_stats = s
+        return s
 
     def _maybe_inject_fault(self, shuffle_id: int = -1) -> None:
         from sparkrdma_tpu import faults as _faults
@@ -587,6 +698,55 @@ class ShuffleExchange:
         return "plain"
 
     # ------------------------------------------------------------------
+    # map-side front half (shared by both regimes)
+    # ------------------------------------------------------------------
+    def _map_side(self, records, partitioner, num_parts: int,
+                  combine: bool, aggregator: str, float_payload: bool,
+                  row_filter, kw_idx):
+        """Shared map-side pass, traced inside the local step of BOTH
+        regimes: partition, predicate pushdown (filtered rows take the
+        out-of-range sentinel pid ``num_parts`` and never occupy a
+        slot), projection pushdown (``kw_idx`` gathers the kept words —
+        payload shrinks before bucketing, so dropped words never hit
+        the wire), then either the map-side combine pass — whose
+        (partition, key) sort already IS the bucketing sort, so its
+        compacted counts come from one :func:`bucket_sorted_counts`
+        histogram — or the plain bucketing sort.
+
+        Returns ``(sr, counts, offsets)`` in ``bucket_records``'s
+        contract; counts are post-filter/post-combine, so the existing
+        size-exchange lane carries the ragged compacted rounds with no
+        wire change."""
+        from sparkrdma_tpu.kernels.aggregate import map_side_combine_cols
+
+        pids = partitioner(records).astype(jnp.int32)
+        if row_filter is not None:
+            pids = jnp.where(row_filter(records), pids,
+                             jnp.int32(num_parts))
+        recs = (records if kw_idx is None
+                else jnp.take(records, kw_idx, axis=0))
+        mode = self.sort_mode(recs.shape[0])
+        if combine:
+            sr, spids, _ = map_side_combine_cols(
+                recs, pids, num_parts, self.conf.key_words, aggregator,
+                float_payload, wide=(mode == "wide"),
+                ride_words=self.conf.wide_sort_ride_words,
+                pack=(mode == "pack"))
+            counts, offs = bucket_sorted_counts(spids, num_parts)
+            return sr, counts, offs
+        # bucket_records' num_parts==1 shortcut skips the histogram (it
+        # counts the whole batch) — under a filter the sentinel rows
+        # must still be counted OUT, so bucket over 2 partitions and
+        # slice the real one back (a no-op slice otherwise)
+        np_eff = num_parts if (num_parts > 1 or row_filter is None) else 2
+        sr, counts, offs = bucket_records(
+            recs, pids, np_eff,
+            wide=(mode == "wide"),
+            ride_words=self.conf.wide_sort_ride_words,
+            pack=(mode == "pack"))
+        return sr, counts[:num_parts], offs[:num_parts]
+
+    # ------------------------------------------------------------------
     # phase 2, regime A: one fused program
     # ------------------------------------------------------------------
     def _build_exec(self, num_parts: int, capacity: int, num_rounds: int,
@@ -597,7 +757,11 @@ class ShuffleExchange:
                     float_payload: bool = False,
                     donate_out: bool = False,
                     tight_out: bool = False,
-                    collective_id: int = 7) -> Callable:
+                    collective_id: int = 7,
+                    combine: bool = False,
+                    row_filter: Optional[Callable] = None,
+                    keep_words: Optional[Tuple[int, ...]] = None
+                    ) -> Callable:
         """``sort_key_words > 0`` fuses the reduce-side key-ordering sort
         into the same compiled program (one dispatch, one XLA schedule —
         the RdmaShuffleReader's ExternalSorter stage inlined).
@@ -608,10 +772,33 @@ class ShuffleExchange:
         and ``totals`` becomes the unique-key count. ``float_payload``
         bitcasts payload words to float32 for the reduction.
         ``donate_out``: program takes a same-shape output buffer to donate
-        (pool-served; the full-overwrite write-through lets XLA alias)."""
+        (pool-served; the full-overwrite write-through lets XLA alias).
+
+        Pre-exchange reduction (the wire-shrinking pass, all fused into
+        the same program): ``combine`` runs the map-side combine before
+        bucketing; ``row_filter`` (jit-safe ``records -> bool[n]``) is
+        the predicate pushdown; ``keep_words`` the projection pushdown —
+        the program moves ``len(keep_words)`` words per record and
+        re-widens (zero-fills) on the reduce side, so the output is
+        always full-width ``[W, out_capacity]``."""
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         ax = self.axis_name
+        w_eff = len(keep_words) if keep_words is not None else record_words
+        kw_idx = (jnp.asarray(keep_words, jnp.int32)
+                  if keep_words is not None else None)
+
+        def rewiden(out):
+            # re-widen a projected output to full record width with
+            # zero-filled dropped payload words — a static W-way stack,
+            # never a scatter (kernels/aggregate.py module docstring)
+            if keep_words is None:
+                return out
+            pos = {wi: i for i, wi in enumerate(keep_words)}
+            zero = jnp.zeros(out.shape[1:], out.dtype)
+            return jnp.stack([out[pos[wi]] if wi in pos else zero
+                              for wi in range(record_words)])
+
         ring_ex = None
         if self._ring_fused_active():
             ring_ex = self._make_ring_exchange(num_rounds, collective_id)
@@ -625,30 +812,67 @@ class ShuffleExchange:
                 # — every record stays put — so skip its ~6 full-array
                 # copies and run the fused tail on the batch directly
                 # (the 1-chip bench's hot path; same spirit as
-                # bucket_records' num_parts==1 short-circuit)
+                # bucket_records' num_parts==1 short-circuit). The
+                # pushdown/combine passes still run so outputs (and
+                # wire accounting via ``incoming``) stay bit-identical
+                # with the multi-chip paths.
+                from sparkrdma_tpu.kernels.aggregate import (
+                    combine_by_key_cols)
+                from sparkrdma_tpu.kernels.sort import sort_by_lead_cols
+
                 n_local = records.shape[1]
-                total = jnp.full((), n_local, jnp.int32)
-                incoming = jnp.full((1, 1), n_local, jnp.int32)
-                out = records
-                if out_capacity != n_local:
-                    out = jnp.pad(records,
-                                  ((0, 0), (0, out_capacity - n_local)))
-                out, total = self._fuse_tail(out, total, out_capacity,
-                                             sort_key_words, aggregator,
-                                             float_payload, tight_out)
+                keep = (row_filter(records) if row_filter is not None
+                        else None)
+                out = (records if kw_idx is None
+                       else jnp.take(records, kw_idx, axis=0))
+                if combine:
+                    # map-side == reduce-side here (single source), so
+                    # one combine pass subsumes both the filter compact
+                    # and the fused tail; dropped rows are just invalid
+                    mode = self.sort_mode(out.shape[0])
+                    valid = (keep if keep is not None
+                             else jnp.ones((n_local,), bool))
+                    out, total = combine_by_key_cols(
+                        out, valid, self.conf.key_words, aggregator,
+                        float_payload, wide=(mode == "wide"),
+                        ride_words=self.conf.wide_sort_ride_words,
+                        pack=(mode == "pack"))
+                    wire = total
+                    if out_capacity != n_local:
+                        out = jnp.pad(
+                            out, ((0, 0), (0, out_capacity - n_local)))
+                else:
+                    total = jnp.full((), n_local, jnp.int32)
+                    if keep is not None:
+                        # stable validity-lead compact: surviving rows
+                        # to the front in arrival order, zeroed tail
+                        mode = self.sort_mode(out.shape[0])
+                        out = sort_by_lead_cols(
+                            out, (~keep).astype(jnp.uint32), mode)
+                        total = jnp.sum(keep).astype(jnp.int32)
+                        live = (jnp.arange(n_local) < total)[None, :]
+                        out = out * live.astype(out.dtype)
+                    wire = total
+                    if out_capacity != n_local:
+                        out = jnp.pad(
+                            out, ((0, 0), (0, out_capacity - n_local)))
+                    out, total = self._fuse_tail(out, total, out_capacity,
+                                                 sort_key_words,
+                                                 aggregator,
+                                                 float_payload, tight_out)
+                incoming = wire.reshape(1, 1).astype(jnp.int32)
+                out = rewiden(out)
                 if maybe_buf:
                     out = lax.dynamic_update_slice(maybe_buf[0], out,
                                                    (0, 0))
                 return out, total[None], incoming[None]
 
-            # --- map side: bucket into per-partition runs -------------
-            pids = partitioner(records).astype(jnp.int32)
-            mode = self.sort_mode(records.shape[0])
-            sr, counts, offs = bucket_records(
-                records, pids, num_parts,
-                wide=(mode == "wide"),
-                ride_words=self.conf.wide_sort_ride_words,
-                pack=(mode == "pack"))
+            # --- map side: bucket into per-partition runs (plus the
+            # --- optional pre-exchange reduction: filter / projection /
+            # --- map-side combine) ------------------------------------
+            sr, counts, offs = self._map_side(
+                records, partitioner, num_parts, combine, aggregator,
+                float_payload, row_filter, kw_idx)
 
             # --- size exchange (metadata fetch analogue) --------------
             dev_counts = _device_partition_counts(
@@ -674,7 +898,7 @@ class ShuffleExchange:
                 # dev_counts[d, q], so the counts land with (not before)
                 # the first payload DMA.
                 lane = jnp.zeros(
-                    (num_rounds, mesh_size, ppd, record_words, 1),
+                    (num_rounds, mesh_size, ppd, w_eff, 1),
                     slots.dtype)
                 lane = lane.at[0, :, :, 0, 0].set(
                     dev_counts.astype(slots.dtype))
@@ -688,7 +912,7 @@ class ShuffleExchange:
                 # stream order (w; q, s, r, c): axes (r, s, q, w, c) ->
                 # (w, q, s, r, c)
                 stream = data.transpose(3, 2, 1, 0, 4).reshape(
-                    record_words,
+                    w_eff,
                     ppd * mesh_size * num_rounds * capacity,
                 )
             else:
@@ -705,7 +929,7 @@ class ShuffleExchange:
                     # group per destination device: [mesh, ppd, W, C]
                     # (partition p = q*mesh + d lives on device d,
                     # local q)
-                    slots = slots.reshape(record_words, ppd, mesh_size,
+                    slots = slots.reshape(w_eff, ppd, mesh_size,
                                           capacity).transpose(2, 1, 0, 3)
                     # dest-major [mesh, ppd, W, C]: the configured
                     # transport moves row d to device d (xla:
@@ -719,7 +943,7 @@ class ShuffleExchange:
                 data = jnp.stack(recv_rounds,
                                  axis=2)       # [mesh, ppd, rounds, W, C]
                 stream = data.transpose(3, 1, 0, 2, 4).reshape(
-                    record_words,
+                    w_eff,
                     ppd * mesh_size * num_rounds * capacity,
                 )
 
@@ -739,6 +963,7 @@ class ShuffleExchange:
             out, total = self._fuse_tail(out, total, out_capacity,
                                          sort_key_words, aggregator,
                                          float_payload, tight_out)
+            out = rewiden(out)
             if maybe_buf:
                 # full-extent write-through into the donated pooled
                 # buffer: same shape in and out, so XLA aliases the pages
@@ -769,19 +994,29 @@ class ShuffleExchange:
     # phase 2, regime B: streaming round chunks (bounded in-flight)
     # ------------------------------------------------------------------
     def _build_prep(self, num_parts: int, record_words: int,
-                    partitioner: Callable) -> Callable:
-        """records -> (bucketed, counts, offsets, incoming, totals)."""
+                    partitioner: Callable,
+                    combine: bool = False,
+                    aggregator: str = "",
+                    float_payload: bool = False,
+                    row_filter: Optional[Callable] = None,
+                    keep_words: Optional[Tuple[int, ...]] = None
+                    ) -> Callable:
+        """records -> (bucketed, counts, offsets, incoming, totals).
+
+        The streaming regime's pre-exchange reduction lives HERE: the
+        prep's counts (and the size exchange they feed) are
+        post-filter/post-combine, so every later chunk program just
+        moves the compacted, possibly narrower (projected) stream —
+        chunk/fold/tail need no combine awareness beyond their width."""
         mesh_size = self.mesh_size
         ax = self.axis_name
+        kw_idx = (jnp.asarray(keep_words, jnp.int32)
+                  if keep_words is not None else None)
 
         def local_prep(records):
-            pids = partitioner(records).astype(jnp.int32)
-            mode = self.sort_mode(records.shape[0])
-            sr, counts, offs = bucket_records(
-                records, pids, num_parts,
-                wide=(mode == "wide"),
-                ride_words=self.conf.wide_sort_ride_words,
-                pack=(mode == "pack"))
+            sr, counts, offs = self._map_side(
+                records, partitioner, num_parts, combine, aggregator,
+                float_payload, row_filter, kw_idx)
             dev_counts = _device_partition_counts(
                 counts, num_parts, mesh_size, ax)
             incoming = lax.all_to_all(
@@ -944,16 +1179,30 @@ class ShuffleExchange:
 
     def _build_tail(self, out_capacity: int, record_words: int,
                     sort_key_words: int, aggregator: str,
-                    float_payload: bool) -> Callable:
+                    float_payload: bool,
+                    full_words: Optional[int] = None,
+                    keep_words: Optional[Tuple[int, ...]] = None
+                    ) -> Callable:
         """(acc, totals) -> (out, totals): strip the accumulator's
-        head-room column band, then apply optional sort/aggregation."""
+        head-room column band, then apply optional sort/aggregation.
+        Under a projection pushdown (``keep_words``) the accumulator is
+        the narrow wire width; the tail re-widens to ``full_words``
+        with zero-filled dropped payload words (static stack, no
+        scatter)."""
         ax = self.axis_name
+        fw = full_words if full_words is not None else record_words
+        pos = ({wi: i for i, wi in enumerate(keep_words)}
+               if keep_words is not None else None)
 
         def local_tail(acc, total):
             out = acc[:, :out_capacity]
             out, t = self._fuse_tail(out, total[0], out_capacity,
                                      sort_key_words, aggregator,
                                      float_payload)
+            if pos is not None:
+                zero = jnp.zeros(out.shape[1:], out.dtype)
+                out = jnp.stack([out[pos[wi]] if wi in pos else zero
+                                 for wi in range(fw)])
             return out, t[None]
 
         return jax.jit(shard_map(
@@ -966,10 +1215,14 @@ class ShuffleExchange:
 
     def _exchange_streaming(self, records, partitioner, plan, num_parts,
                             sort_key_words, aggregator, float_payload,
-                            shuffle_id=-1):
+                            shuffle_id=-1, combine=False, row_filter=None,
+                            keep_words=None):
         """Regime B driver: prep, paced round chunks, folds, tail."""
         conf = self.conf
         w = records.shape[0]
+        # projection pushdown: everything downstream of prep moves (and
+        # folds) the narrow wire width; the tail re-widens
+        w_eff = len(keep_words) if keep_words is not None else w
         mesh_size = self.mesh_size
         ppd = num_parts // mesh_size
         cap = plan.capacity
@@ -977,6 +1230,8 @@ class ShuffleExchange:
         n_chunks = math.ceil(plan.num_rounds / F)
         total_rounds = n_chunks * F
         pkey = getattr(partitioner, "cache_key", id(partitioner))
+        fkey = (getattr(row_filter, "cache_key", id(row_filter))
+                if row_filter is not None else None)
 
         def cached(key, builder):
             fn = self._exec_cache.get(key)
@@ -987,14 +1242,19 @@ class ShuffleExchange:
 
         from sparkrdma_tpu.exchange.ring import derive_collective_id
 
-        prep = cached(("prep", num_parts, w, pkey),
-                      lambda: self._build_prep(num_parts, w, partitioner))
+        prep = cached(("prep", num_parts, w, pkey, fkey, keep_words,
+                       combine, aggregator, float_payload),
+                      lambda: self._build_prep(
+                          num_parts, w, partitioner, combine=combine,
+                          aggregator=aggregator,
+                          float_payload=float_payload,
+                          row_filter=row_filter, keep_words=keep_words))
         # tenant folded in: two tenants' identically-shaped streaming
         # exchanges must derive distinct collective ids (and programs)
-        chunk_key = ("chunk", self.tenant, num_parts, cap, F, w)
+        chunk_key = ("chunk", self.tenant, num_parts, cap, F, w_eff)
         chunk_fn = cached(chunk_key,
                           lambda: self._build_chunk(
-                              num_parts, cap, F, w,
+                              num_parts, cap, F, w_eff,
                               collective_id=derive_collective_id(chunk_key)))
 
         self.timeline.begin("stream:prep", chunks=n_chunks,
@@ -1004,9 +1264,9 @@ class ShuffleExchange:
         self.timeline.end("stream:prep")
 
         # +cap head-room per device so fold windows never clamp
-        acc_shape = (w, mesh_size * (plan.out_capacity + cap))
+        acc_shape = (w_eff, mesh_size * (plan.out_capacity + cap))
         out_sharding = NamedSharding(self.mesh, P(None, self.axis_name))
-        recv_shape = (F, mesh_size * mesh_size, ppd, w, cap)
+        recv_shape = (F, mesh_size * mesh_size, ppd, w_eff, cap)
         # recv chunks are sharded over their *destination* axis; the
         # global layout is [F, dest_mesh * src_mesh, ppd, W, C]
         recv_sharding = out_sharding
@@ -1072,9 +1332,10 @@ class ShuffleExchange:
                     tl.end("ring:round", round=j * F + jr)
             fold = cached(
                 ("fold", num_parts, cap, F, total_rounds,
-                 plan.out_capacity, w, j == 0),
+                 plan.out_capacity, w_eff, j == 0),
                 lambda: self._build_fold(num_parts, cap, F, total_rounds,
-                                         plan.out_capacity, w, j == 0))
+                                         plan.out_capacity, w_eff,
+                                         j == 0))
             cidx = jnp.full((1,), j, jnp.int32)
             acc, token = fold(acc, recv, incoming, cidx)
             dispatches += 2
@@ -1087,11 +1348,12 @@ class ShuffleExchange:
                 # it now lets chunk j+1 donate the same pages (the runtime
                 # sequences the rewrite after the fold's read)
                 self._put_buf(recv, recv_sharding)
-        tail = cached(("tail", plan.out_capacity, w, sort_key_words,
-                       aggregator, float_payload),
+        tail = cached(("tail", plan.out_capacity, w_eff, sort_key_words,
+                       aggregator, float_payload, w, keep_words),
                       lambda: self._build_tail(
-                          plan.out_capacity, w, sort_key_words,
-                          aggregator, float_payload))
+                          plan.out_capacity, w_eff, sort_key_words,
+                          aggregator, float_payload,
+                          full_words=w, keep_words=keep_words))
         out, totals = tail(acc, totals)
         dispatches += 1
         tl.event("stream:tail")
@@ -1115,6 +1377,8 @@ class ShuffleExchange:
         sort_key_words: int = 0,
         aggregator: str = "",
         float_payload: bool = False,
+        row_filter: Optional[Callable] = None,
+        keep_words: Optional[Tuple[int, ...]] = None,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Run the planned exchange.
 
@@ -1125,6 +1389,16 @@ class ShuffleExchange:
           partitioner: jit-safe ``records -> int32[n]`` destination
             partition ids; must match the one used in :meth:`plan`.
           plan: output of :meth:`plan`.
+          row_filter: predicate pushdown — jit-safe
+            ``records -> bool[n]`` over FULL-width records; rows it
+            drops never occupy a slot (they are invisible to the
+            output, as if deleted before the shuffle). Give it a stable
+            ``cache_key`` attribute or every call recompiles.
+          keep_words: projection pushdown — strictly-increasing word
+            indices to keep on the wire; must include every key word.
+            Dropped payload words come back zero-filled in ``out``
+            (the :class:`~sparkrdma_tpu.api.serde.RowSchema` of the
+            caller tracks which columns are live).
 
         Returns ``(out, totals, incoming)``:
           - ``out``: columnar ``uint32[W, mesh*out_capacity]`` — device
@@ -1136,6 +1410,16 @@ class ShuffleExchange:
 
         When the exchange owns a pool, ``out`` is recycled into the next
         same-geometry exchange (see module docstring: consume it first).
+
+        When ``aggregator`` is set, the plan-time combine gate
+        (:meth:`_combine_gate`, driven by ``conf.map_side_combine``)
+        may additionally run the map-side combine before bucketing;
+        outputs are bit-identical either way (the reduce-side combine
+        still merges across sources), only wire bytes change —
+        :meth:`wire_stats` reports the measured reduction. A map-side
+        combine program that fails to build degrades through the same
+        ladder as the transports (sticky combine-off retry, counted and
+        journaled) when ``conf.combine_fallback`` is on.
         """
         # The plan's counts matrix is the source of truth for geometry —
         # a mismatched explicit num_parts would silently drop records in
@@ -1156,28 +1440,90 @@ class ShuffleExchange:
                 plan.split_factor)
         if aggregator and aggregator not in ("sum", "min", "max"):
             raise ValueError(f"unsupported aggregator {aggregator!r}")
+        w = records.shape[0]
+        if keep_words is not None:
+            keep_words = tuple(int(i) for i in keep_words)
+            kw = self.conf.key_words
+            if (len(keep_words) < kw
+                    or keep_words[:kw] != tuple(range(kw))):
+                raise ValueError(
+                    f"keep_words must start with all {kw} key words")
+            if any(b <= a for a, b in zip(keep_words, keep_words[1:])):
+                raise ValueError("keep_words must be strictly increasing")
+            if keep_words[-1] >= w:
+                raise ValueError(
+                    f"keep_words {keep_words} out of range for W={w}")
+            if len(keep_words) == w:
+                keep_words = None    # full width: not a projection
+        self._last_wire = None
+        self._last_wire_stats = {}
         self._maybe_inject_fault(shuffle_id)
         m = self.metrics
         m.counter("exchange.exchanges").inc()
         m.counter("exchange.rounds").inc(plan.num_rounds)
         m.counter("exchange.records").inc(plan.total_records)
+        if row_filter is not None:
+            m.counter("pushdown.filters").inc()
+        if keep_words is not None:
+            m.counter("pushdown.projections").inc()
+        from sparkrdma_tpu.exchange.errors import FetchFailedError
+
+        # attempt 0 runs whatever the combine gate decides; if the
+        # map-side-combine program itself fails to build/trace, the
+        # combine rung of the degradation ladder retries ONCE with
+        # combine off (sticky). Injected fetch faults are real exchange
+        # failures, not construction failures — they stay on the
+        # reader's retry path, never this rung.
+        for attempt in (0, 1):
+            use_combine, dup_ratio = self._combine_gate(records, aggregator)
+            try:
+                out, totals, incoming = self._dispatch(
+                    records, partitioner, plan, num_parts, shuffle_id,
+                    sort_key_words, aggregator, float_payload,
+                    use_combine, row_filter, keep_words)
+            except FetchFailedError:
+                raise
+            except Exception as exc:
+                if (attempt == 0 and use_combine
+                        and self.conf.combine_fallback):
+                    self._degrade_combine(exc)
+                    continue
+                raise
+            self._note_wire(records, incoming, use_combine,
+                            row_filter is not None, keep_words, dup_ratio)
+            return out, totals, incoming
+
+    def _dispatch(self, records, partitioner, plan, num_parts, shuffle_id,
+                  sort_key_words, aggregator, float_payload,
+                  use_combine, row_filter, keep_words):
+        """One dispatch attempt of the planned exchange (either regime);
+        :meth:`exchange` wraps it in the combine-fallback rung."""
         if plan.num_rounds > self.conf.max_rounds_in_flight:
             return self._exchange_streaming(
                 records, partitioner, plan, num_parts,
                 sort_key_words, aggregator, float_payload,
-                shuffle_id=shuffle_id)
+                shuffle_id=shuffle_id, combine=use_combine,
+                row_filter=row_filter, keep_words=keep_words)
         w = records.shape[0]
         # every device's output exactly full -> the fused sort can drop
-        # its validity lead operand (static fact from the plan's counts)
+        # its validity lead operand (static fact from the plan's counts;
+        # any pre-exchange reduction shrinks totals below the plan, so
+        # it forces the validity operand back on)
         owned = plan.counts.sum(axis=0)
         per_dev = np.array([owned[d::self.mesh_size].sum()
                             for d in range(self.mesh_size)])
-        tight = bool((per_dev == plan.out_capacity).all())
+        pushed = (use_combine or row_filter is not None
+                  or keep_words is not None)
+        tight = (not pushed
+                 and bool((per_dev == plan.out_capacity).all()))
+        fkey = (getattr(row_filter, "cache_key", id(row_filter))
+                if row_filter is not None else None)
         # tenant folded in so two tenants' same-geometry fused programs
         # (and their derived collective ids) never alias
         key = (self.tenant, num_parts, plan.capacity, plan.num_rounds,
                plan.out_capacity,
                w, sort_key_words, aggregator, float_payload, tight,
+               use_combine, fkey, keep_words,
                getattr(partitioner, "cache_key", id(partitioner)))
         donate = self.pool is not None
         fn = self._exec_cache.get(key)
@@ -1188,10 +1534,13 @@ class ShuffleExchange:
                                   plan.out_capacity, w, partitioner,
                                   sort_key_words, aggregator, float_payload,
                                   donate_out=donate, tight_out=tight,
-                                  collective_id=derive_collective_id(key))
+                                  collective_id=derive_collective_id(key),
+                                  combine=use_combine,
+                                  row_filter=row_filter,
+                                  keep_words=keep_words)
             self._exec_cache[key] = fn
         self.last_dispatches = 1
-        m.counter("exchange.dispatches").inc()
+        self.metrics.counter("exchange.dispatches").inc()
         self.timeline.begin("exchange:fused", rounds=plan.num_rounds)
         if self._ring_fused_active():
             # structural annotations: the rounds run INSIDE one kernel
@@ -1312,6 +1661,7 @@ class ShuffleExchange:
                 store_prefetch_hits=st_hits,
                 store_sync_fetches=st_sync,
                 tenant=self.tenant,
+                **self.wire_stats(),
             )
             weight = self.sampler.keep_weight(span_id, t.elapsed)
             if self.rollup is not None:

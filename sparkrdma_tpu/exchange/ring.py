@@ -226,6 +226,15 @@ def make_ring_exchange(mesh, axis_name: str, num_rounds: int,
     same contract as R independent ``lax.all_to_all(split_axis=0,
     concat_axis=0, tiled=True)`` calls, but one kernel: one barrier,
     double-buffered rounds, fabric/fold overlap.
+
+    The kernel is shape-generic over every trailing dim of the slots —
+    it DMAs whatever ``[...]`` block the caller packed. Map-side
+    combine and projection pushdown lean on exactly that: a projected
+    exchange ships a narrower record width and a combined one packs
+    compacted (ragged, count-prefixed) rounds, and both ride through
+    here with NO wire-protocol change — the PR-7 size-exchange lane in
+    ``exchange/protocol.py`` already carries the ragged per-destination
+    counts in round 0's one-column prefix.
     """
     from sparkrdma_tpu.obs.metrics import MetricsRegistry
 

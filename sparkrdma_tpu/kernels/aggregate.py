@@ -154,6 +154,53 @@ def combine_by_key_cols(
     return out, num_unique
 
 
+def map_side_combine_cols(
+    records: jax.Array,
+    part_ids: jax.Array,
+    num_parts: int,
+    key_words: int,
+    op: str = "sum",
+    float_payload: bool = False,
+    wide: bool = False,
+    ride_words: int = 0,
+    pack: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pre-exchange reduction: collapse duplicate (partition, key) pairs.
+
+    The map half of Spark's Aggregator (map-side combine), phrased for
+    the exchange's bucketing contract: the destination partition id is
+    prepended as an extra leading key word, so ONE
+    :func:`combine_by_key_cols` pass both sorts the batch by
+    ``(dest partition, key)`` AND segment-reduces equal keys — each
+    (partition, key) pair then occupies one slot in the round layout.
+
+    ``part_ids`` outside ``[0, num_parts)`` mark rows already dropped by
+    a predicate pushdown; they are treated as invalid and never reach
+    the output (filter and combine compose in the same pass).
+
+    Returns ``(combined [W, N], new_pids int32[N], num_unique)``:
+    ``combined``'s first ``num_unique`` columns are the surviving rows
+    sorted ascending by (partition, key) with reduced payloads (zero
+    tail); ``new_pids`` carries their partition ids with the sentinel
+    ``num_parts`` on the tail, ascending — exactly the
+    ``sorted_ids`` form :func:`~sparkrdma_tpu.kernels.bucketing
+    .histogram_pids` consumes, so the caller needs no second bucketing
+    sort.
+    """
+    w, n = records.shape
+    part_ids = part_ids.astype(jnp.int32)
+    cols = jnp.concatenate(
+        [part_ids.astype(jnp.uint32)[None], records], axis=0)
+    valid = (part_ids >= 0) & (part_ids < num_parts)
+    combined, num_unique = combine_by_key_cols(
+        cols, valid, 1 + key_words, op, float_payload,
+        wide=wide, ride_words=ride_words, pack=pack)
+    live = jnp.arange(n) < num_unique
+    new_pids = jnp.where(live, combined[0].astype(jnp.int32),
+                         jnp.int32(num_parts))
+    return combined[1:], new_pids, num_unique
+
+
 def combine_by_key(
     records: jax.Array,
     valid: jax.Array,
@@ -176,4 +223,5 @@ def count_by_key(records: jax.Array, valid: jax.Array,
     return combine_by_key(with_ones, valid, key_words, op="sum")
 
 
-__all__ = ["combine_by_key", "combine_by_key_cols", "count_by_key"]
+__all__ = ["combine_by_key", "combine_by_key_cols",
+           "map_side_combine_cols", "count_by_key"]

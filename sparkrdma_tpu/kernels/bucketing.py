@@ -143,6 +143,29 @@ def bucket_records(
     return bucketed, counts, offsets
 
 
+def bucket_sorted_counts(
+    sorted_pids: jax.Array, num_parts: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Counts/offsets for a batch ALREADY sorted ascending by partition.
+
+    The map-side-combine and predicate-pushdown paths produce their
+    bucketed layout directly (``map_side_combine_cols`` sorts by
+    (partition, key); dropped rows carry the sentinel pid ``num_parts``
+    on the tail), so :func:`bucket_records`' own sort would be a wasted
+    full pass — this computes just its index-file half. Sentinel rows
+    fall outside ``[0, num_parts)`` and are therefore excluded from
+    every count: they never occupy a slot in
+    :func:`fill_round_slots` / :func:`fill_round_slots_dest_major`
+    (whose per-window masks derive from these counts).
+    """
+    counts = histogram_pids(sorted_pids, num_parts,
+                            sorted_ids=sorted_pids.astype(jnp.int32))
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    return counts, offsets
+
+
 def fill_round_slots(
     bucketed: jax.Array,
     counts: jax.Array,
@@ -295,5 +318,5 @@ def compact_segments(
     return packed, total
 
 
-__all__ = ["bucket_records", "fill_round_slots",
+__all__ = ["bucket_records", "bucket_sorted_counts", "fill_round_slots",
            "fill_round_slots_dest_major", "compact_segments"]

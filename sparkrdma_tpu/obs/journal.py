@@ -101,7 +101,14 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: process-cumulative. The v4 fields remain TOTALS across both codec
 #: paths (pickle share = total − columnar), so pre-v8 consumers and the
 #: rollup's serde series keep their meaning unchanged.
-SCHEMA_VERSION = 8
+#: v9: + ``combine_{in,out}_{records,bytes}`` (measured map-side-combine
+#: wire reduction), ``combine_dup_ratio`` (the combine gate's sampled
+#: duplicate-key estimate — present on every aggregator read, combine
+#: on or off, so ``--doctor`` can flag missed combines), and
+#: ``pushdown_rows_dropped``/``pushdown_words_dropped`` (predicate /
+#: projection pushdown deltas). PER-SPAN values (not cumulative) —
+#: exchange/protocol.py §wire_stats.
+SCHEMA_VERSION = 9
 
 
 @dataclasses.dataclass
@@ -162,6 +169,19 @@ class ExchangeSpan:
     serde_columnar_encode_s: float = 0.0
     serde_columnar_decode_bytes: int = 0
     serde_columnar_decode_s: float = 0.0
+    # --- pre-exchange reduction accounting (schema v9) — PER-SPAN, not
+    # cumulative: the measured map-side-combine wire reduction
+    # (in/out records and bytes of THIS read's exchange), the combine
+    # gate's sampled duplicate-key ratio (journaled for every
+    # aggregator read so the doctor can flag combines that should have
+    # run), and the predicate/projection pushdown deltas ---
+    combine_in_records: int = 0
+    combine_out_records: int = 0
+    combine_in_bytes: int = 0
+    combine_out_bytes: int = 0
+    combine_dup_ratio: float = 0.0
+    pushdown_rows_dropped: int = 0
+    pushdown_words_dropped: int = 0
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

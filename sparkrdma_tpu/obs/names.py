@@ -55,6 +55,11 @@ COUNTERS = frozenset({
     "exchange.exchanges",
     "exchange.rounds",
     "exchange.records",
+    "combine.gate_on",
+    "combine.gate_off",
+    "combine.fallbacks",
+    "pushdown.filters",
+    "pushdown.projections",
     "store.puts",
     "store.put_bytes",
     "store.spill_writes",

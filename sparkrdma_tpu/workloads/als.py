@@ -14,11 +14,18 @@ TPU-native layout mirroring that structure:
 - ratings are held twice, statically: sharded by item owner (for the
   user-update half-step) and by user owner (for the item-update half-step)
   — the OutBlock analogue;
-- each half-step builds records ``key=(0, dst_entity)``, ``payload =
-  [rating bits, factor vector bits...]`` on the factor's owner device, runs
-  the slotted exchange, and the receiving device accumulates the normal
-  equations ``A += f f^T, b += r f`` by scatter-add and solves the batched
-  k×k systems (``jnp.linalg.solve`` — MXU-batched, no per-entity loop).
+- each half-step builds PARTIAL NORMAL-EQUATION records ``key=(0,
+  dst_entity)``, ``payload = [r·f (k words), upper-tri(f f^T)
+  (k(k+1)/2 words)]`` on the factor's owner device and runs the slotted
+  exchange as a map-side-combined ``reduce_by_key``: ``aggregator="sum"``
+  engages both the PRE-exchange combine pass (same-destination partials
+  fold on the source device before bucketing, gated on the sampled
+  duplicate ratio) and the reader's fused aggregator, so the receiving
+  device gets ONE summed ``(A, b)`` per owned entity and just solves the
+  batched k×k systems (``jnp.linalg.solve`` — MXU-batched, no per-entity
+  loop). Shipping partials instead of raw factors is what makes the
+  shuffle combinable at all: factor vectors can't be summed, their
+  normal-equation contributions can.
 
 Both exchange *plans* are computed once and reused every iteration: the
 rating graph is static so the counts matrices never change — the same
@@ -99,8 +106,12 @@ def _edge_tables(ratings: np.ndarray, owner_col: int, mesh: int):
 
 
 def _make_build_fn(runtime: MeshRuntime, k: int, w: int):
-    """records = static base with payload <- [rating, factor[src_local]]."""
+    """records = static base with payload <- the edge's PARTIAL normal
+    equations ``[r·f (k), upper-tri(f f^T) (k(k+1)/2)]`` — an associative
+    sum payload, so the map-side combine pass and the reader's fused
+    ``sum`` aggregator can both fold same-destination records."""
     ax = runtime.axis_name
+    tri_i, tri_j = (jnp.asarray(x) for x in np.triu_indices(k))
 
     def build(factors_local, base_local, srcidx_local, rating_local,
               mask_local):
@@ -108,8 +119,10 @@ def _make_build_fn(runtime: MeshRuntime, k: int, w: int):
         f = jnp.take(factors_local, srcidx_local[:, 0], axis=0)  # [E, k]
         f = jnp.where(mask_local, f, 0.0)
         r = jnp.where(mask_local[:, 0], rating_local[:, 0], 0.0)
+        b_p = r[:, None] * f                       # [E, k]
+        a_p = f[:, tri_i] * f[:, tri_j]            # [E, k(k+1)/2]
         payload = jax.lax.bitcast_convert_type(
-            jnp.concatenate([r[:, None], f], axis=1), jnp.uint32)
+            jnp.concatenate([b_p, a_p], axis=1), jnp.uint32)
         return jnp.concatenate([base_local[:2], payload.T], axis=0)
 
     return jax.jit(shard_map(
@@ -121,26 +134,34 @@ def _make_build_fn(runtime: MeshRuntime, k: int, w: int):
 
 def _make_update_fn(runtime: MeshRuntime, k: int, per: int, out_cap: int,
                     mesh: int, lam: float):
-    """Received factor records -> solved factors for locally-owned entities.
+    """Received (already key-summed) partial normal equations -> solved
+    factors for locally-owned entities.
 
-    The normal-equation accumulate (A += f f^T, b += r f) and the batched
-    k×k solve — per-entity scatter-add with mode="drop" for padding, then
-    one batched linalg.solve (maps to MXU-batched triangular solves)."""
+    The exchange's combine + fused aggregator already folded ``A`` and
+    ``b`` per destination entity, so this just scatters each entity's
+    summed partials into the owner slice (mode="drop" for padding),
+    unpacks the symmetric upper triangle, and runs one batched
+    linalg.solve (maps to MXU-batched triangular solves)."""
     ax = runtime.axis_name
+    ntri = k * (k + 1) // 2
+    tri_i, tri_j = (jnp.asarray(x) for x in np.triu_indices(k))
 
     def update(received, total):
         # received: columnar [w, out_cap]
         valid = jnp.arange(out_cap) < total[0]
         dst = received[1].astype(jnp.int32)
-        fr = jax.lax.bitcast_convert_type(received[2:3 + k], jnp.float32)
-        r = jnp.where(valid, fr[0], 0.0)
-        f = jnp.where(valid[:, None], fr[1:].T, 0.0)           # [cap, k]
+        fr = jax.lax.bitcast_convert_type(received[2:2 + k + ntri],
+                                          jnp.float32)
+        b_rows = jnp.where(valid[None], fr[:k], 0.0).T      # [cap, k]
+        a_rows = jnp.where(valid[None], fr[k:], 0.0).T      # [cap, ntri]
         idx = jnp.where(valid, dst // mesh, per)
-        outer = f[:, :, None] * f[:, None, :]                   # [cap, k, k]
-        A = jnp.zeros((per, k, k), jnp.float32).at[idx].add(
-            outer, mode="drop")
         b = jnp.zeros((per, k), jnp.float32).at[idx].add(
-            r[:, None] * f, mode="drop")
+            b_rows, mode="drop")
+        a_tri = jnp.zeros((per, ntri), jnp.float32).at[idx].add(
+            a_rows, mode="drop")
+        A = jnp.zeros((per, k, k), jnp.float32)
+        A = A.at[:, tri_i, tri_j].set(a_tri)
+        A = A.at[:, tri_j, tri_i].set(a_tri)   # diagonal rewrites itself
         A = A + lam * jnp.eye(k, dtype=jnp.float32)[None]
         return jnp.linalg.solve(A, b[:, :, None])[:, :, 0]      # [per, k]
 
@@ -162,12 +183,18 @@ def run_als(
     seed: int = 0,
     verify: bool = True,
     slot_records: Optional[int] = None,
+    map_side_combine: Optional[str] = None,
 ) -> ALSResult:
-    """Run ALS with a per-half-iteration factor exchange."""
+    """Run ALS with a per-half-iteration map-side-combined partial-sum
+    exchange. ``map_side_combine`` forces the combine gate ("on"/"off")
+    for benchmarking; the default defers to the runtime conf ("auto")."""
     mesh = runtime.num_partitions
-    conf = runtime.conf.replace(val_words=1 + rank)
+    conf = runtime.conf.replace(
+        val_words=rank + rank * (rank + 1) // 2)
     if slot_records is not None:
         conf = conf.replace(slot_records=slot_records)
+    if map_side_combine is not None:
+        conf = conf.replace(map_side_combine=map_side_combine)
     ex = ShuffleExchange(runtime.mesh, runtime.axis_name, conf)
     part = modulo_partitioner(mesh, key_word=1)
     w = conf.record_words
@@ -216,13 +243,15 @@ def run_als(
 
     t0 = time.perf_counter()
     for _ in range(iterations):
-        # user half-step: shuffle item factors to user owners
+        # user half-step: shuffle item-side partial sums to user owners
         rec = build_fn(V, ubase, usrc, urate, umask_g)
-        out, totals, _ = ex.exchange(rec, part, uplan, mesh)
+        out, totals, _ = ex.exchange(rec, part, uplan, mesh,
+                                     aggregator="sum", float_payload=True)
         U = user_update(out, totals)
-        # item half-step: shuffle user factors to item owners
+        # item half-step: shuffle user-side partial sums to item owners
         rec = build_fn(U, ibase, isrc, irate, imask_g)
-        out, totals, _ = ex.exchange(rec, part, iplan, mesh)
+        out, totals, _ = ex.exchange(rec, part, iplan, mesh,
+                                     aggregator="sum", float_payload=True)
         # Stage barrier per half-iteration pair (see pagerank.py note).
         V = item_update(out, totals)
         barrier(V)

@@ -11,6 +11,15 @@ payload = float32 bits of rank[src]/outdeg[src]), runs the slotted
 exchange, combines by key in HBM, and scatters the sums into the owner's
 dense rank slice.
 
+The per-iteration shuffle is a map-side-combined ``reduce_by_key``: the
+exchange carries ``aggregator="sum"``, so the PRE-exchange combine pass
+(exchange/protocol.py §map-side combine) folds same-destination-vertex
+contributions on the source device before bucketing whenever the gate's
+sampled duplicate-ratio clears the threshold — a power-law graph ships
+one record per (device, dst) instead of one per edge. ``map_side_combine``
+forces the gate for benchmarking ("on"/"off"); the default defers to the
+runtime conf ("auto" gates on the measured ratio).
+
 The exchange *plan* is computed once and reused for every iteration: the
 graph is static, so the counts matrix never changes — the same observation
 that lets the reference cache RdmaMapTaskOutput tables across fetches
@@ -59,11 +68,15 @@ def run_pagerank(
     damping: float = 0.85,
     verify: bool = True,
     slot_records: Optional[int] = None,
+    map_side_combine: Optional[str] = None,
 ) -> PageRankResult:
     mesh = runtime.num_partitions
     ax = runtime.axis_name
-    conf = runtime.conf if slot_records is None else runtime.conf.replace(
-        slot_records=slot_records)
+    conf = runtime.conf
+    if slot_records is not None:
+        conf = conf.replace(slot_records=slot_records)
+    if map_side_combine is not None:
+        conf = conf.replace(map_side_combine=map_side_combine)
     ex = ShuffleExchange(runtime.mesh, ax, conf)
     part = modulo_partitioner(mesh, key_word=1)  # dst vertex owner, lo word
 

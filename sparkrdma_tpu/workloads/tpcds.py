@@ -9,11 +9,14 @@ workload runs that shape through the PUBLIC ShuffleManager API:
                attaches item.category to each fact row;
   exchange 2   re-partition the enriched fact + store dim by store_key;
                local PK-join looks up store.region, the region filter
-               masks non-qualifying rows' values to 0;
+               marks non-qualifying rows with the null key 0;
   exchange 3   re-partition by category with the reader's FUSED
                ``aggregator="sum"`` (the Spark Aggregator stage inlined
-               into the exchange program): output = unique categories
-               with summed values.
+               into the exchange program) AND the region filter PUSHED
+               DOWN (``row_filter`` drops key-0 rows before bucketing,
+               so dead rows never occupy a wire slot — they used to ship
+               as value-0 rows and aggregate into a discarded group):
+               output = unique categories with summed values.
 
 TPU-native design points: dimension joins are primary-key lookups, so
 the join output has the FACT's shape (fixed — no variable-length row
@@ -90,8 +93,11 @@ def _pk_lookup_program(manager: ShuffleManager, cap_f: int, cap_d: int,
             qual = found & (a < pred_cutoff)
             p0 = jnp.where(qual, fc[3], jnp.uint32(0))
             # carry the key forward: after the filter join the NEXT key
-            # is the carried category (payload0 of the enriched fact)
-            out = jnp.stack([jnp.zeros_like(fk), next_key,
+            # is the carried category (payload0 of the enriched fact).
+            # Non-qualifying rows get the null key 0 so the downstream
+            # exchange's pushed-down predicate can drop them pre-wire.
+            nk = jnp.where(qual, next_key, jnp.uint32(0))
+            out = jnp.stack([jnp.zeros_like(fk), nk,
                              p0, jnp.zeros_like(fk)])
         else:
             out = jnp.stack([jnp.zeros_like(fk), next_key,
@@ -103,6 +109,17 @@ def _pk_lookup_program(manager: ShuffleManager, cap_f: int, cap_d: int,
         in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
         out_specs=P(None, ax),
     ))
+
+
+def _drop_null_key(records):
+    """Pushed-down region predicate for exchange 3: stage 2 marked
+    non-qualifying rows with the null key 0, so dropping key-0 rows at
+    the exchange ships only qualifying bytes. Output is unchanged —
+    the key-0 group was discarded host-side anyway."""
+    return records[1] != jnp.uint32(0)
+
+
+_drop_null_key.cache_key = ("tpcds_drop_null",)
 
 
 def _lookup(manager, cap_f, cap_d, mask_with_pred, pred_cutoff):
@@ -178,8 +195,8 @@ def run_q64_shape(
     handle = manager.register_shuffle(sids[4], mesh, part)
     writer = manager.get_writer(handle).write(filtered)
     writer.stop(True)
-    gout, gtot = manager.get_reader(handle,
-                                    aggregator="sum").read()
+    gout, gtot = manager.get_reader(handle, aggregator="sum",
+                                    row_filter=_drop_null_key).read()
     barrier(gout)
     shuffle_s = time.perf_counter() - t0
 
@@ -200,12 +217,15 @@ def run_q64_shape(
         cat_of = {int(item[i, 1]): int(item[i, 2]) for i in range(n_items)}
         reg_of = {int(store[i, 1]): int(store[i, 2])
                   for i in range(n_stores)}
+        # WHERE-before-GROUP-BY reference: a category with no
+        # qualifying rows has no group at all (the pushed-down filter
+        # drops its rows pre-wire; the old masking implementation
+        # shipped them as value-0 rows and emitted empty groups)
         ref: Dict[int, int] = {}
         for i in range(nf):
-            cat = cat_of[int(fact[i, 1])]
-            qualifies = reg_of[int(fact[i, 2])] < region_cutoff
-            ref[cat] = ref.get(cat, 0) + (int(fact[i, 3]) if qualifies
-                                          else 0)
+            if reg_of[int(fact[i, 2])] < region_cutoff:
+                cat = cat_of[int(fact[i, 1])]
+                ref[cat] = ref.get(cat, 0) + int(fact[i, 3])
         verified = groups == ref
 
     return QueryResult(

@@ -347,6 +347,149 @@ def test_chained_verbs_stay_on_device(manager, rng):
     assert got == ref
 
 
+class TestFilterSelectPushdown:
+    """Logical filter/select verbs: the fused pushdown path must agree
+    with the eager-materialized path and with numpy, and select must
+    narrow what hits the wire while decoding back zero-filled."""
+
+    @staticmethod
+    def schema():
+        from sparkrdma_tpu.api.serde import RowSchema
+
+        # payload: a (word 2), b (word 3), c int64 (words 4-5)
+        return RowSchema([("a", "uint32"), ("b", "uint32"), ("c", "int64")])
+
+    @staticmethod
+    def data(rng, n=8 * 50):
+        x = np.zeros((n, 6), dtype=np.uint32)
+        x[:, 1] = rng.integers(0, 7, size=n, dtype=np.uint32)
+        for c in range(2, 6):
+            x[:, c] = rng.integers(0, 2**31, size=n, dtype=np.uint32)
+        return x
+
+    @pytest.fixture()
+    def wide_manager(self):
+        m = ShuffleManager(conf=ShuffleConf(slot_records=256, val_words=4))
+        yield m
+        m.stop()
+
+    @staticmethod
+    def odd_a(records):
+        return (records[2] & 1) == 1
+
+    def test_filter_fused_vs_eager_vs_numpy(self, wide_manager, rng):
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        flt = ds.filter(self.odd_a, cache_key=("odd_a",))
+        ref = x[(x[:, 2] & 1) == 1]
+        # eager path: count + host exits materialize the pending filter
+        assert flt.count == ref.shape[0]
+        np.testing.assert_array_equal(canon(flt.to_host_rows()), canon(ref))
+        # fused path: the filter pushes into the repartition exchange
+        got = flt.repartition().to_host_rows()
+        np.testing.assert_array_equal(canon(got), canon(ref))
+
+    def test_chained_filters_and(self, wide_manager, rng):
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+
+        def small_key(records):
+            return records[1] < 4
+
+        small_key.cache_key = ("small_key",)
+        got = (ds.filter(self.odd_a, cache_key=("odd_a",))
+               .filter(small_key).repartition().to_host_rows())
+        ref = x[((x[:, 2] & 1) == 1) & (x[:, 1] < 4)]
+        np.testing.assert_array_equal(canon(got), canon(ref))
+
+    def test_select_fused_zero_fills_and_projects(self, wide_manager, rng):
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        sel = ds.select("a", "c").repartition()
+        assert sel.projected == ("a", "c")
+        ref = x.copy()
+        ref[:, 3] = 0                       # b projected away -> zeros
+        np.testing.assert_array_equal(canon(sel.to_host_rows()), canon(ref))
+        _, cols = sel.to_host_columns()
+        assert not np.any(np.asarray(cols["b"]))
+        a = np.asarray(cols["a"])
+        np.testing.assert_array_equal(np.sort(a), np.sort(ref[:, 2]))
+
+    def test_select_validation(self, wide_manager, rng):
+        x = self.data(rng, n=8 * 4)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        with pytest.raises(ValueError):
+            ds.select()                       # empty projection
+        with pytest.raises(KeyError, match="no column"):
+            ds.select("nope")
+        with pytest.raises(ValueError):
+            ds.select("a").select("b")        # b already projected away
+        m2 = ShuffleManager(conf=ShuffleConf(slot_records=256, val_words=4))
+        try:
+            with pytest.raises(ValueError, match="schema"):
+                Dataset.from_host_rows(m2, x).select("a")
+        finally:
+            m2.stop()
+
+    def test_filter_select_reduce_by_key(self, wide_manager, rng):
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        got = (ds.filter(self.odd_a, cache_key=("odd_a",))
+               .select("a").reduce_by_key("sum").to_host_rows())
+        kept = x[(x[:, 2] & 1) == 1].copy()
+        kept[:, 3:] = 0                      # b, c projected away
+        ref = {}
+        for r in kept:
+            k = (int(r[0]), int(r[1]))
+            ref[k] = (ref.get(k, 0) + int(r[2])) % (1 << 32)
+        got_map = {(int(r[0]), int(r[1])): int(r[2]) for r in got}
+        assert got_map == ref
+        assert not np.any(got[:, 3:])
+
+    def test_filter_before_sort_and_count_by_key(self, wide_manager, rng):
+        """Verbs that must materialize first (sampler/to_ones rewrite
+        records) still honor a pending filter."""
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        flt = ds.filter(self.odd_a, cache_key=("odd_a",))
+        ref = x[(x[:, 2] & 1) == 1]
+        srt = flt.sort_by_key().to_host_rows()
+        assert srt.shape[0] == ref.shape[0]
+        keys = srt[:, 0].astype(np.uint64) << np.uint64(32) | srt[:, 1]
+        assert np.all(keys[1:] >= keys[:-1])
+        np.testing.assert_array_equal(canon(srt), canon(ref))
+        cbk = flt.count_by_key().to_host_rows()
+        refc = {}
+        for k in ref[:, 1]:
+            refc[(0, int(k))] = refc.get((0, int(k)), 0) + 1
+        assert {(int(r[0]), int(r[1])): int(r[2]) for r in cbk} == refc
+
+
+class TestCombineDatasetParity:
+    """reduce_by_key through managers with the combine pass forced on
+    vs off: bit-identical Datasets, shrunken wire bytes when on."""
+
+    def test_on_off_parity_and_wire_stats(self, rng):
+        x = np.zeros((8 * 64, 4), dtype=np.uint32)
+        x[:, 1] = rng.integers(0, 10, size=x.shape[0], dtype=np.uint32)
+        x[:, 2] = rng.integers(0, 2**32, size=x.shape[0], dtype=np.uint32)
+        outs, stats = {}, {}
+        for mode in ("on", "off"):
+            m = ShuffleManager(conf=ShuffleConf(slot_records=256,
+                                                map_side_combine=mode))
+            try:
+                ds = Dataset.from_host_rows(m, x).reduce_by_key("sum")
+                outs[mode] = ds.to_host_rows()
+                stats[mode] = dict(m._exchange.wire_stats())
+            finally:
+                m.stop()
+        np.testing.assert_array_equal(outs["on"], outs["off"])
+        assert stats["on"]["combine_out_bytes"] \
+            < stats["on"]["combine_in_bytes"]
+        assert "combine_in_bytes" not in stats["off"]
+        assert stats["off"]["combine_dup_ratio"] > 0.5  # doctor's signal
+
+
 def test_dense_records_skewed_devices(manager, rng):
     """Device-side densification with wildly unequal per-device valid
     counts (one device nearly empty): filler columns must pad every

@@ -403,6 +403,330 @@ def test_single_device_degenerate_exchange(rng):
         rt.stop()
 
 
+def dup_key_records(rng, rt, n_per_dev, n_keys, w=4):
+    """Duplicate-heavy keyed records: key word 1 drawn from a small
+    space (word 0 zero), random payload words — the shape the map-side
+    combine pass exists for."""
+    n = n_per_dev * rt.num_partitions
+    x = np.zeros((n, w), dtype=np.uint32)
+    x[:, 1] = rng.integers(0, n_keys, size=n, dtype=np.uint32)
+    for c in range(2, w):
+        x[:, c] = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    return rt.shard_records(x), x
+
+
+def np_reduce_by_key(x, op="sum", kw=2):
+    """{key tuple: reduced payload} with uint32 wraparound sums."""
+    ref = {}
+    for r in x:
+        k = tuple(int(v) for v in r[:kw])
+        p = r[kw:].astype(np.uint64)
+        if k not in ref:
+            ref[k] = p.copy()
+        elif op == "sum":
+            ref[k] = (ref[k] + p) % (1 << 32)
+        elif op == "min":
+            ref[k] = np.minimum(ref[k], p)
+        else:
+            ref[k] = np.maximum(ref[k], p)
+    return ref
+
+
+class TestMapSideCombine:
+    """The pre-exchange reduction pass: ``map_side_combine="on"`` must
+    be bit-identical to ``"off"`` in every regime (the reader-side
+    combine still merges across sources either way — combine only
+    changes wire bytes, which :meth:`wire_stats` must show shrinking)."""
+
+    def _pair(self, rt, **conf_kw):
+        on = ShuffleExchange(rt.mesh, rt.axis_name,
+                             ShuffleConf(map_side_combine="on", **conf_kw))
+        off = ShuffleExchange(rt.mesh, rt.axis_name,
+                              ShuffleConf(map_side_combine="off", **conf_kw))
+        return on, off
+
+    def _run(self, ex, xg, part, num_parts, agg):
+        plan = ex.plan(xg, part, num_parts=num_parts)
+        out, tot, _ = ex.exchange(xg, part, plan, aggregator=agg)
+        return np.asarray(out), np.asarray(tot), plan
+
+    @pytest.mark.parametrize("agg", ["sum", "min"])
+    def test_fused_parity_and_wire_reduction(self, exchange, rng, agg):
+        _, rt = exchange
+        xg, xn = dup_key_records(rng, rt, 48, 13)
+        part = hash_partitioner(8)
+        ex_on, ex_off = self._pair(rt, slot_records=16,
+                                   max_rounds_in_flight=8)
+        out_on, tot_on, _ = self._run(ex_on, xg, part, 8, agg)
+        out_off, tot_off, _ = self._run(ex_off, xg, part, 8, agg)
+        np.testing.assert_array_equal(tot_on, tot_off)
+        np.testing.assert_array_equal(out_on, out_off)
+        ws = ex_on.wire_stats()
+        assert ws["combine_out_records"] < ws["combine_in_records"]
+        assert ws["combine_out_bytes"] < ws["combine_in_bytes"]
+        assert "combine_in_bytes" not in ex_off.wire_stats()
+        # the combined result IS the reduce-by-key answer
+        got = collect_valid_rows(out_on, tot_on, out_on.shape[1] // 8)
+        ref = np_reduce_by_key(xn, agg)
+        assert {tuple(map(int, r[:2])): tuple(map(int, r[2:]))
+                for r in got} \
+            == {k: tuple(map(int, v)) for k, v in ref.items()}
+
+    def test_streaming_parity(self, exchange, rng):
+        """max_rounds_in_flight=1 forces the streaming regime; the
+        combined per-round ragged counts ride the size-exchange lane."""
+        _, rt = exchange
+        xg, xn = dup_key_records(rng, rt, 64, 7)
+        part = hash_partitioner(8)
+        ex_on, ex_off = self._pair(rt, slot_records=16,
+                                   max_rounds_in_flight=1, max_rounds=64)
+        out_on, tot_on, plan_on = self._run(ex_on, xg, part, 8, "sum")
+        out_off, tot_off, _ = self._run(ex_off, xg, part, 8, "sum")
+        assert plan_on.num_rounds > 1, "geometry must force streaming"
+        np.testing.assert_array_equal(tot_on, tot_off)
+        np.testing.assert_array_equal(out_on, out_off)
+
+    def test_ring_fused_parity(self, exchange, rng):
+        """transport="pallas_ring" (fused multi-round kernel, interpret
+        mode on CPU): combine on/off parity, and vs the xla transport."""
+        _, rt = exchange
+        xg, xn = dup_key_records(rng, rt, 40, 9)
+        part = hash_partitioner(8)
+        ex_on, ex_off = self._pair(rt, slot_records=16,
+                                   max_rounds_in_flight=8,
+                                   transport="pallas_ring")
+        out_on, tot_on, _ = self._run(ex_on, xg, part, 8, "sum")
+        out_off, tot_off, _ = self._run(ex_off, xg, part, 8, "sum")
+        np.testing.assert_array_equal(tot_on, tot_off)
+        np.testing.assert_array_equal(out_on, out_off)
+        ex_xla = ShuffleExchange(rt.mesh, rt.axis_name,
+                                 ShuffleConf(map_side_combine="on",
+                                             slot_records=16,
+                                             max_rounds_in_flight=8))
+        out_x, tot_x, _ = self._run(ex_xla, xg, part, 8, "sum")
+        np.testing.assert_array_equal(tot_on, tot_x)
+        np.testing.assert_array_equal(out_on, out_x)
+
+    def test_ragged_compacted_rounds(self, exchange, rng):
+        """Skew into one partition (40 records over capacity-16 slots =
+        rounds [16, 16, 8]): the combine pass compacts each source's
+        contribution, so late rounds go ragged-to-empty — totals and
+        content must still match combine-off exactly."""
+        _, rt = exchange
+        n = 8 * 40
+        x = np.zeros((n, 4), dtype=np.uint32)
+        x[:, 0] = 5                        # all -> partition 5
+        x[:, 1] = rng.integers(0, 11, size=n, dtype=np.uint32)
+        x[:, 2] = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+        xg = rt.shard_records(x)
+        part = modulo_partitioner(8)
+        ex_on, ex_off = self._pair(rt, slot_records=16,
+                                   max_rounds_in_flight=8)
+        out_on, tot_on, plan_on = self._run(ex_on, xg, part, 8, "sum")
+        out_off, tot_off, _ = self._run(ex_off, xg, part, 8, "sum")
+        assert plan_on.num_rounds == 3     # planned on PRE-combine counts
+        np.testing.assert_array_equal(tot_on, tot_off)
+        np.testing.assert_array_equal(out_on, out_off)
+
+    def test_single_device_parity(self, rng):
+        """mesh=1: the short-circuited exchange honors the combine flag
+        both ways and still produces the reduce-by-key answer."""
+        import jax
+
+        from sparkrdma_tpu import MeshRuntime
+
+        outs = {}
+        for mode in ("on", "off"):
+            conf = ShuffleConf(slot_records=1 << 20, map_side_combine=mode)
+            rt = MeshRuntime(conf, devices=jax.devices()[:1])
+            try:
+                ex = ShuffleExchange(rt.mesh, rt.axis_name, conf,
+                                     pool=rt.pool)
+                n = 600
+                x = np.zeros((n, 4), dtype=np.uint32)
+                x[:, 1] = rng.integers(0, 9, size=n, dtype=np.uint32)
+                x[:, 2] = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+                xg = rt.shard_records(x)
+                part = modulo_partitioner(1)
+                plan = ex.plan(xg, part, num_parts=1)
+                out, tot, _ = ex.exchange(xg, part, plan, aggregator="sum")
+                k = int(np.asarray(tot)[0])
+                outs[mode] = np.asarray(out)[:, :k].T.copy()
+            finally:
+                rt.stop()
+            rng = np.random.default_rng(0)   # same data both modes
+        np.testing.assert_array_equal(outs["on"], outs["off"])
+        ref = np_reduce_by_key(x, "sum")
+        got = {tuple(map(int, r[:2])): tuple(map(int, r[2:]))
+               for r in outs["on"]}
+        assert got == {k: tuple(map(int, v)) for k, v in ref.items()}
+
+    def test_degradation_ladder_fallback(self, exchange, rng, monkeypatch):
+        """A map-side-combine program that fails to construct must
+        degrade through the PR-5 ladder: sticky combine-off retry, the
+        ``combine.fallbacks`` counter moves, the degradation is noted —
+        and the output is still the correct combined answer."""
+        from sparkrdma_tpu import faults
+        from sparkrdma_tpu.kernels import aggregate
+        from sparkrdma_tpu.obs.metrics import MetricsRegistry
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected combine construction failure")
+
+        monkeypatch.setattr(aggregate, "map_side_combine_cols", boom)
+        _, rt = exchange
+        xg, xn = dup_key_records(rng, rt, 32, 7)
+        part = hash_partitioner(8)
+        reg = MetricsRegistry(enabled=True)
+        conf = ShuffleConf(slot_records=16, map_side_combine="on")
+        ex = ShuffleExchange(rt.mesh, rt.axis_name, conf, metrics=reg)
+        faults.reset_accounting()
+        try:
+            plan = ex.plan(xg, part, num_parts=8)
+            out, tot, _ = ex.exchange(xg, part, plan, aggregator="sum")
+            assert int(reg.counter("combine.fallbacks").value) == 1
+            assert ex._combine_override, "combine-off must be sticky"
+            assert "combine" in faults.active_degradations()
+            got = collect_valid_rows(np.asarray(out), np.asarray(tot),
+                                     np.asarray(out).shape[1] // 8)
+            ref = np_reduce_by_key(xn, "sum")
+            assert {tuple(map(int, r[:2])): tuple(map(int, r[2:]))
+                    for r in got} \
+                == {k: tuple(map(int, v)) for k, v in ref.items()}
+            # a second exchange must not retry combine construction
+            ex.exchange(xg, part, plan, aggregator="sum")
+            assert int(reg.counter("combine.fallbacks").value) == 1
+        finally:
+            faults.reset_accounting()
+
+    def test_combine_fallback_off_raises(self, exchange, rng, monkeypatch):
+        """combine_fallback=False: construction failures surface instead
+        of silently shipping uncombined."""
+        from sparkrdma_tpu.kernels import aggregate
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected combine construction failure")
+
+        monkeypatch.setattr(aggregate, "map_side_combine_cols", boom)
+        _, rt = exchange
+        xg, _ = dup_key_records(rng, rt, 16, 5)
+        part = hash_partitioner(8)
+        conf = ShuffleConf(slot_records=16, map_side_combine="on",
+                           combine_fallback=False)
+        ex = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+        plan = ex.plan(xg, part, num_parts=8)
+        with pytest.raises(RuntimeError, match="injected combine"):
+            ex.exchange(xg, part, plan, aggregator="sum")
+
+
+class TestPushdownExchange:
+    """Predicate/projection pushdown at the exchange layer: dropped rows
+    never occupy a slot, dropped words never hit the wire (re-widened
+    zero-filled on the reader)."""
+
+    def test_row_filter_matches_prefiltered_shuffle(self, exchange, rng):
+        _, rt = exchange
+        xg, xn = make_global_records(rng, rt, 32)
+        part = modulo_partitioner(8)
+
+        def keep_even(records):
+            return (records[2] & 1) == 0
+
+        keep_even.cache_key = ("keep_even_w2",)
+        ex = ShuffleExchange(rt.mesh, rt.axis_name,
+                             ShuffleConf(slot_records=16))
+        plan = ex.plan(xg, part, num_parts=8)
+        out, tot, _ = ex.exchange(xg, part, plan, row_filter=keep_even)
+        mask = (xn[:, 2] & 1) == 0
+        kept = xn[mask]
+        pids = np.asarray(part(jnp.asarray(kept.T)))
+        # reference: shuffle of the PRE-filtered rows. Source order is
+        # preserved within each device, so the reference applies.
+        n_per_dev = xn.shape[0] // rt.num_partitions
+        dev_of = np.repeat(np.arange(rt.num_partitions), n_per_dev)[mask]
+        cap = plan.out_capacity
+        out_np, tot_np = np.asarray(out), np.asarray(tot)
+        for d in range(rt.num_partitions):
+            ref = np.concatenate(
+                [kept[(dev_of == s) & (pids == d)]
+                 for s in range(rt.num_partitions)])
+            k = int(tot_np[d])
+            assert k == len(ref)
+            np.testing.assert_array_equal(
+                out_np[:, d * cap:d * cap + k].T, ref)
+        assert tot_np.sum() == mask.sum()
+        ws = ex.wire_stats()
+        assert ws["pushdown_rows_dropped"] == int((~mask).sum())
+
+    def test_keep_words_projection_zero_fills(self, exchange, rng):
+        _, rt = exchange
+        xg, xn = make_global_records(rng, rt, 32, w=6)
+        part = modulo_partitioner(8)
+        conf = ShuffleConf(slot_records=16, val_words=4)
+        ex = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+        plan = ex.plan(xg, part, num_parts=8)
+        out, tot, _ = ex.exchange(xg, part, plan, keep_words=(0, 1, 3, 5))
+        # reference: full shuffle of x with words 2 and 4 zeroed
+        x_ref = xn.copy()
+        x_ref[:, 2] = 0
+        x_ref[:, 4] = 0
+        ex_full = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+        out_f, tot_f, _ = ex_full.exchange(
+            rt.shard_records(x_ref), part, ex_full.plan(
+                rt.shard_records(x_ref), part, num_parts=8))
+        np.testing.assert_array_equal(np.asarray(tot), np.asarray(tot_f))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_f))
+        ws = ex.wire_stats()
+        assert ws["pushdown_words_dropped"] == 2 * int(np.asarray(tot).sum())
+
+    def test_keep_words_validation(self, exchange, rng):
+        _, rt = exchange
+        xg, _ = make_global_records(rng, rt, 8)
+        part = modulo_partitioner(8)
+        ex = ShuffleExchange(rt.mesh, rt.axis_name,
+                             ShuffleConf(slot_records=16))
+        plan = ex.plan(xg, part, num_parts=8)
+        with pytest.raises(ValueError, match="key words"):
+            ex.exchange(xg, part, plan, keep_words=(0, 2))   # missing kw 1
+        with pytest.raises(ValueError, match="increasing"):
+            ex.exchange(xg, part, plan, keep_words=(0, 1, 3, 3))
+        with pytest.raises(ValueError, match="out of range"):
+            ex.exchange(xg, part, plan, keep_words=(0, 1, 9))
+
+    def test_filter_projection_combine_together(self, exchange, rng):
+        """All three pushdowns composed, on/off combine parity."""
+        _, rt = exchange
+        xg, xn = dup_key_records(rng, rt, 48, 11, w=6)
+        part = hash_partitioner(8)
+
+        def keep_small(records):
+            return records[1] < 8
+
+        keep_small.cache_key = ("keep_small_k",)
+        outs = {}
+        for mode in ("on", "off"):
+            conf = ShuffleConf(slot_records=16, map_side_combine=mode,
+                               val_words=4)
+            ex = ShuffleExchange(rt.mesh, rt.axis_name, conf)
+            plan = ex.plan(xg, part, num_parts=8)
+            out, tot, _ = ex.exchange(xg, part, plan, aggregator="sum",
+                                      row_filter=keep_small,
+                                      keep_words=(0, 1, 2, 4))
+            outs[mode] = (np.asarray(out).copy(), np.asarray(tot).copy())
+        np.testing.assert_array_equal(outs["on"][1], outs["off"][1])
+        np.testing.assert_array_equal(outs["on"][0], outs["off"][0])
+        # vs numpy: filter, project (zero words 3 and 5), reduce
+        kept = xn[xn[:, 1] < 8].copy()
+        kept[:, 3] = 0
+        kept[:, 5] = 0
+        ref = np_reduce_by_key(kept, "sum")
+        got = collect_valid_rows(outs["on"][0], outs["on"][1],
+                                 outs["on"][0].shape[1] // 8)
+        assert {tuple(map(int, r[:2])): tuple(map(int, r[2:]))
+                for r in got} \
+            == {k: tuple(map(int, v)) for k, v in ref.items()}
+
+
 def test_plan_rejects_out_of_range_partitioner(exchange, rng):
     """A buggy partitioner emitting ids outside [0, num_parts) must fail
     loudly at plan time, not silently understate counts (round-3

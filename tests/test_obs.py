@@ -153,7 +153,7 @@ class TestJournal:
                          retry_count=1, pool_high_water=4, spill_count=2)
         d = span.to_dict()
         assert d["total_bytes"] == span.records * span.record_bytes
-        assert d["schema"] == 8
+        assert d["schema"] == 9
         back = ExchangeSpan.from_dict(d)
         assert back == span
 
@@ -220,9 +220,9 @@ V1_FIELDS = ("span_id", "shuffle_id", "transport", "rounds", "dispatches",
 
 
 class TestSchemaVersioning:
-    def test_schema_version_is_five(self):
-        assert SCHEMA_VERSION == 8
-        assert make_span().schema == 8
+    def test_schema_version_is_nine(self):
+        assert SCHEMA_VERSION == 9
+        assert make_span().schema == 9
 
     def test_v1_line_parses_under_v2_reader(self):
         """A journal written before the timeline existed still reads:
@@ -255,6 +255,87 @@ class TestSchemaVersioning:
         span = ExchangeSpan.from_dict(v1_view)   # what a v1 reader builds
         assert span.records == d["records"]
         assert span.per_peer_records == d["per_peer_records"]
+
+
+#: the v8 field set (schema v8 = v9 minus the combine/pushdown wire
+#: fields); pins the v8 <-> v9 interchange contract
+V9_ONLY_FIELDS = ("combine_in_records", "combine_out_records",
+                  "combine_in_bytes", "combine_out_bytes",
+                  "combine_dup_ratio", "pushdown_rows_dropped",
+                  "pushdown_words_dropped")
+
+
+class TestCombineSchemaV9:
+    """v8 <-> v9 journal interchange + the wire-reduction report/doctor
+    surface over the new per-span combine/pushdown fields."""
+
+    def test_v8_line_parses_under_v9_reader(self):
+        """A pre-combine journal line: every new field defaults to zero
+        (combine never ran, nothing pushed down) and the line's own
+        schema stamp survives."""
+        d = make_span().to_dict()
+        for f in V9_ONLY_FIELDS:
+            d.pop(f)
+        d["schema"] = 8
+        span = ExchangeSpan.from_dict(d)
+        assert span.schema == 8
+        assert span.combine_in_records == 0
+        assert span.combine_out_bytes == 0
+        assert span.combine_dup_ratio == 0.0
+        assert span.pushdown_rows_dropped == 0
+        assert span.pushdown_words_dropped == 0
+
+    def test_v9_line_parses_under_v8_reader(self):
+        """The v8 reader is the same drop-unknown-keys from_dict minus
+        the v9 fields; a v9 line must lose nothing it relied on."""
+        d = make_span(combine_in_records=100, combine_out_records=10,
+                      combine_in_bytes=1600, combine_out_bytes=160,
+                      combine_dup_ratio=0.9,
+                      pushdown_rows_dropped=5,
+                      pushdown_words_dropped=50).to_dict()
+        v8_view = {k: v for k, v in d.items() if k not in V9_ONLY_FIELDS}
+        span = ExchangeSpan.from_dict(v8_view)
+        assert span.records == d["records"]
+        assert span.per_peer_records == d["per_peer_records"]
+
+    def test_report_wire_section(self):
+        spans = [make_span(span_id=1, combine_in_records=400,
+                           combine_out_records=40,
+                           combine_in_bytes=6400, combine_out_bytes=640,
+                           combine_dup_ratio=0.9).to_dict(),
+                 make_span(span_id=2, pushdown_rows_dropped=7,
+                           pushdown_words_dropped=21,
+                           combine_dup_ratio=0.1).to_dict()]
+        wire = shuffle_report.aggregate(spans)["wire"]
+        assert wire["combine_in_bytes"] == 6400
+        assert wire["combine_out_bytes"] == 640
+        assert wire["combine_reduction_ratio"] == pytest.approx(10.0)
+        assert wire["max_dup_ratio"] == pytest.approx(0.9)
+        assert wire["pushdown_rows_dropped"] == 7
+        assert wire["pushdown_words_dropped"] == 21
+
+    def test_doctor_missed_combine_rule(self):
+        """High sampled duplication with zero combined bytes: the span
+        shipped duplicates it could have folded."""
+        spans = [make_span(shuffle_id=6, combine_dup_ratio=0.8).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any('map_side_combine="on"' in f and "[6]" in f
+                   for f in findings)
+        # combine actually ran -> no finding
+        ran = [make_span(shuffle_id=6, combine_dup_ratio=0.8,
+                         combine_in_bytes=1600,
+                         combine_out_bytes=320).to_dict()]
+        assert not any("map_side_combine" in f
+                       for f in shuffle_report.diagnose(ran, []))
+        # low duplication -> no finding
+        low = [make_span(shuffle_id=6, combine_dup_ratio=0.1).to_dict()]
+        assert not any("map_side_combine" in f
+                       for f in shuffle_report.diagnose(low, []))
+
+    def test_doctor_combine_degradation_hint(self):
+        spans = [make_span(degraded=["combine"]).to_dict()]
+        findings = shuffle_report.diagnose(spans, [])
+        assert any("combine" in f and "uncombined" in f for f in findings)
 
 
 class _ExplodingSink(io.StringIO):
@@ -530,7 +611,7 @@ class TestManagerJournalE2E:
         manager, plan = self._run_shuffle(conf, rng)
         (span,) = read_journal(str(sink))
         assert span.shuffle_id == 90
-        assert span.schema == 8
+        assert span.schema == 9
         assert span.transport == conf.transport
         assert span.rounds == plan.num_rounds
         assert span.records == plan.total_records

@@ -366,6 +366,13 @@ class TestSchemaV2V3:
             "serde_columnar_encode_s",
             "serde_columnar_decode_bytes",
             "serde_columnar_decode_s",
+            "combine_in_records",              # v9: map-side combine
+            "combine_out_records",
+            "combine_in_bytes",
+            "combine_out_bytes",
+            "combine_dup_ratio",
+            "pushdown_rows_dropped",           # v9: predicate/projection pushdown
+            "pushdown_words_dropped",
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)
