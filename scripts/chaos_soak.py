@@ -32,7 +32,12 @@ seed replays exactly.
    tenant's output must be bit-identical to a solo control run through
    its own service, and its journal spans must show zero retries, zero
    injected-fault events and no degradations — the noisy tenant's
-   chaos stays inside its own session plane.
+   chaos stays inside its own session plane. The service also runs its
+   wire probe (``probe_port=0``, ephemeral) **under fire**: a monitor
+   thread polls ``/snapshot`` + ``/journal`` throughout the faulted
+   run, one client connects and hangs up mid-response, and afterwards
+   the probe must still answer a complete request with zero leaked
+   threads once the service stops.
 
 4. **Map-side combine under fire** — a duplicate-heavy
    ``reduce_by_key`` with the pre-exchange combine pass forced ON runs
@@ -204,6 +209,24 @@ def run_service_tenant_leg(svc, tenant, conf, seed, records_per_device,
         svc.close_session(m)
 
 
+def probe_fetch(port: int, path: str, timeout: float = 5.0):
+    """One request over the probe's newline wire format (send
+    ``GET <path>\\n``, read to EOF) -> decoded JSON body. Raises
+    OSError/ValueError on connection or decode failure."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(f"GET {path}\n".encode("ascii"))
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode("utf-8"))
+
+
 def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
     """The blast-radius pass: noisy + clean tenants through one service.
 
@@ -215,6 +238,11 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
     - ``clean_retries`` / ``clean_fault_events`` / ``clean_degraded``:
       summed over the clean tenant's journal spans — all must be zero
     - ``noisy_sites_hit``: the noisy plane must have actually fired
+    - ``probe``: the probe-under-fire verdict — the service's wire
+      probe polled throughout the faulted run (``polls_ok > 0``), still
+      answering after a client hung up mid-response
+      (``post_kill_snapshot_ok``), and no threads outliving the
+      service (``leaked_threads`` empty)
     """
     import threading
 
@@ -233,10 +261,10 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
         control, _ = run_service_tenant_leg(
             svc, "clean", None, args.seed + 10, rpd, shuffle_id=12)
 
-    # --- shared service: both tenants concurrently ---------------------
+    # --- shared service: both tenants concurrently, probe under fire ---
     journal = os.path.join(tmp, "svc_journal.jsonl")
     conf_svc = ShuffleConf(spill_dir=os.path.join(tmp, "svc_duo"),
-                           metrics_sink=journal, **common)
+                           metrics_sink=journal, probe_port=0, **common)
     conf_noisy = ShuffleConf(spill_dir=os.path.join(tmp, "svc_duo"),
                              metrics_sink=journal, fault_spec=noisy_spec,
                              **common)
@@ -250,7 +278,29 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
         except Exception as e:   # surfaced in the summary, not lost
             errors.append(f"{name}: {e!r}")
 
+    before_threads = {t.name for t in threading.enumerate()}
+    tally = {"polls_ok": 0, "poll_errors": 0}
+    stop_evt = threading.Event()
+    kill_err = ""
+    post_ok = False
     with ShuffleService(conf=conf_svc) as svc:
+        port = svc.probe.port if svc.probe is not None else -1
+
+        def monitor():
+            # poll both JSON surfaces the whole time the tenants run —
+            # the probe must serve while faults fire in the data plane
+            while not stop_evt.is_set():
+                for path in ("/snapshot", "/journal"):
+                    try:
+                        probe_fetch(port, path)
+                        tally["polls_ok"] += 1
+                    except (OSError, ValueError):
+                        tally["poll_errors"] += 1
+                stop_evt.wait(0.02)
+
+        mon = threading.Thread(target=monitor, daemon=True,
+                               name="chaos-probe-monitor")
+        mon.start()
         threads = [
             threading.Thread(target=tenant_run,
                              args=("noisy", conf_noisy, 11,
@@ -260,8 +310,39 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
         ]
         for t in threads:
             t.start()
+        # killed client: connect, read one byte, slam the connection
+        # shut mid-response while the tenants shuffle under faults
+        try:
+            import socket
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5.0) as s:
+                s.sendall(b"GET /journal\n")
+                s.recv(1)
+        except OSError as e:
+            kill_err = repr(e)
         for t in threads:
             t.join()
+        stop_evt.set()
+        mon.join(5.0)
+        # the probe must still answer a COMPLETE request after the kill
+        try:
+            post = probe_fetch(port, "/snapshot")
+            post_ok = isinstance(post, dict) and "telemetry" in post
+        except (OSError, ValueError):
+            post_ok = False
+    # service stopped: nothing it started may outlive it
+    leaked = sorted({t.name for t in threading.enumerate()}
+                    - before_threads - {"chaos-probe-monitor"})
+    probe_leg = {
+        "ok": (port >= 0 and tally["polls_ok"] > 0 and post_ok
+               and not kill_err and not leaked),
+        "port": port,
+        "polls_ok": tally["polls_ok"],
+        "poll_errors": tally["poll_errors"],
+        "killed_client_error": kill_err,
+        "post_kill_snapshot_ok": post_ok,
+        "leaked_threads": leaked,
+    }
 
     clean_spans = [s for s in read_spans(journal)
                    if s.get("tenant") == "clean"]
@@ -277,7 +358,8 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
     identical = clean_out is not None and outputs_equal(control, clean_out)
     ok = (not errors and identical and bool(clean_spans)
           and clean_retries == 0 and clean_faults == 0
-          and not clean_degraded and bool(noisy_sites))
+          and not clean_degraded and bool(noisy_sites)
+          and probe_leg["ok"])
     return {
         "ok": ok,
         "errors": errors,
@@ -287,6 +369,7 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
         "clean_fault_events": clean_faults,
         "clean_degraded": clean_degraded,
         "noisy_sites_hit": noisy_sites,
+        "probe": probe_leg,
     }
 
 

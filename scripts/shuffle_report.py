@@ -28,9 +28,14 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
   predicate/projection pushdown kept OFF the fabric, summed over the
   per-span ``combine_*`` / ``pushdown_*`` fields, with the measured
   pre/post-combine ratio;
+- critical path (schema v10): per-shuffle phase breakdown from the
+  span-embedded ``phase_s`` attribution (plan / combine / encode / H2D /
+  dispatch / queue-block / spill / admission-wait / other), the
+  dominant ``bottleneck`` verdict per shuffle, and the cross-host
+  straggler delta on multi-journal merges;
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
-  stalls, retries, combinable-but-uncombined shuffles) to the
-  ShuffleConf knob that addresses them.
+  stalls, retries, combinable-but-uncombined shuffles, bottleneck
+  verdicts) to the ShuffleConf knob that addresses them.
 
 Rotated journals (``j.jsonl.1``, ``.2``, … from
 ``ShuffleConf.journal_max_bytes``) are walked automatically — pass the
@@ -548,6 +553,97 @@ def host_breakdown(spans: List[dict]) -> dict:
     return {"hosts": hosts, "per_shuffle": shuffles}
 
 
+#: cross-host spread (max/min of per-host mean exchange seconds) at or
+#: above which a shuffle's merged verdict becomes straggler-bound
+#: (stdlib mirror of ``obs.critical_path.STRAGGLER_RATIO``)
+STRAGGLER_RATIO = 2.0
+
+#: display order of the critical-path phases (schema v10 ``phase_s``)
+PHASE_ORDER = ("plan", "combine", "encode", "h2d", "dispatch",
+               "queue_block", "d2h", "decode", "fold", "spill",
+               "admission_wait", "other")
+
+
+def critical_path_report(spans: List[dict]) -> dict:
+    """Per-shuffle critical-path rollup of the schema-v10 attribution.
+
+    Sums each shuffle's ``phase_s`` dicts across spans and hosts, votes
+    a dominant ``bottleneck`` from the per-span verdicts, and derives
+    the cross-host straggler delta (per-host mean exchange seconds,
+    multi-journal merges) — flipping the merged verdict to
+    ``straggler-bound`` when the spread ratio crosses
+    :data:`STRAGGLER_RATIO`. Empty for pre-v10 journals."""
+    shuffles: Dict[int, dict] = {}
+    host_ex: Dict[int, Dict[int, List[float]]] = {}
+    for s in spans:
+        sid = int(s.get("shuffle_id", -1))
+        host = int(s.get("process_index", 0) or 0)
+        host_ex.setdefault(sid, {}).setdefault(host, []).append(
+            float(s.get("exchange_s", 0.0) or 0.0))
+        ph = s.get("phase_s")
+        if not isinstance(ph, dict):
+            continue
+        cell = shuffles.setdefault(sid, {
+            "spans": 0, "phase_s": {}, "votes": {}})
+        cell["spans"] += 1
+        for p, v in ph.items():
+            cell["phase_s"][p] = (cell["phase_s"].get(p, 0.0)
+                                  + float(v or 0.0))
+        verdict = str(s.get("bottleneck", "") or "")
+        if verdict:
+            cell["votes"][verdict] = cell["votes"].get(verdict, 0) + 1
+    out: Dict[str, dict] = {}
+    for sid, cell in sorted(shuffles.items()):
+        phases = {p: round(v, 6) for p, v in cell["phase_s"].items()}
+        total = sum(phases.values())
+        top = sorted(((p, v) for p, v in phases.items()
+                      if p != "other"), key=lambda kv: kv[1],
+                     reverse=True)[:3]
+        votes = cell["votes"]
+        verdict = (max(sorted(votes), key=lambda v: votes[v])
+                   if votes else "")
+        means = {h: sum(ts) / len(ts)
+                 for h, ts in host_ex.get(sid, {}).items() if ts}
+        straggler = None
+        if len(means) > 1:
+            slow = max(means, key=lambda h: means[h])
+            hi, lo = means[slow], min(means.values())
+            ratio = hi / lo if lo > 0 else 0.0
+            straggler = {"delta_s": round(hi - lo, 6),
+                         "ratio": round(ratio, 3),
+                         "slowest_host": slow}
+            if ratio >= STRAGGLER_RATIO:
+                verdict = "straggler-bound"
+        out[str(sid)] = {
+            "spans": cell["spans"],
+            "phase_s": phases,
+            "phase_share": {p: round(v / total, 4) if total > 0 else 0.0
+                            for p, v in phases.items()},
+            "top_phases": [{"phase": p, "seconds": round(v, 6)}
+                           for p, v in top],
+            "bottleneck": verdict,
+            "straggler": straggler,
+        }
+    return out
+
+
+def print_critical_path(cp: dict) -> None:
+    print(f"critical path (schema v10 phase attribution, "
+          f"{len(cp)} shuffle(s)):")
+    for sid, c in cp.items():
+        ph = c["phase_s"]
+        total = sum(ph.values())
+        parts = "  ".join(
+            f"{p}={ph[p]:.4f}s ({c['phase_share'].get(p, 0.0):.0%})"
+            for p in PHASE_ORDER if p in ph and ph[p] > 0)
+        verdict = c["bottleneck"] or "unattributed"
+        print(f"  shuffle {sid}: {verdict}   {parts}")
+        st = c.get("straggler")
+        if st:
+            print(f"    cross-host: slowest host {st['slowest_host']} "
+                  f"+{st['delta_s']:.4f}s ({st['ratio']:.2f}x spread)")
+
+
 #: skew past this ratio is a geometry problem, not noise — matches the
 #: skew-split planner's own intervention threshold territory
 DOCTOR_SKEW_THRESHOLD = 4.0
@@ -719,6 +815,37 @@ def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
         findings.append(
             f"sticky degradation(s) active {degraded} — results stay "
             f"correct but slower ({detail})")
+    # critical-path verdicts (schema v10): each shuffle's dominant
+    # bottleneck maps to the knob that moves it
+    verdict_advice = {
+        "codec-bound": "host serde dominates the wall-clock — declare a "
+                       "RowSchema so the columnar v2 codec runs, enable "
+                       "the native codec (serde_native=True) and raise "
+                       "serde_threads",
+        "spill-bound": "tiered-store traffic dominates — raise "
+                       "spill_tier_host_bytes (size for >= "
+                       "spill_tier_prefetch + 2 chunks) and "
+                       "spill_tier_prefetch so rounds stop waiting on "
+                       "disk",
+        "admission-bound": "reads queue in the fair-queueing controller "
+                           "— raise admission_slots / admission_quantum "
+                           "or rebalance tenant quotas "
+                           "(tenant_hbm_slots / tenant_host_bytes)",
+        "straggler-bound": "one host's exchange time dwarfs the fleet's "
+                           "— every host waits in ICI barriers for it; "
+                           "check that host's heartbeat, rss and "
+                           "degradation list before touching shuffle "
+                           "knobs",
+    }
+    by_verdict: Dict[str, List[str]] = {}
+    for sid, c in critical_path_report(spans).items():
+        if c["bottleneck"] in verdict_advice:
+            by_verdict.setdefault(c["bottleneck"], []).append(sid)
+    for verdict in sorted(by_verdict):
+        sids = by_verdict[verdict]
+        findings.append(
+            f"shuffle(s) {sids} are {verdict}: "
+            f"{verdict_advice[verdict]}")
     corrupt = [e for s in spans for e in (s.get("events") or [])
                if e.get("name") == "fault:injected"
                and e.get("action") == "corrupt"]
@@ -967,6 +1094,7 @@ def main(argv=None) -> int:
         heartbeats.extend(kinds["heartbeat"])
         admissions.extend(kinds["admission"])
     rep = aggregate(spans)
+    cp_rep = critical_path_report(spans)
     tenant_rep = tenant_breakdown({
         "span": spans, "stall": stalls, "rollup": rollups,
         "heartbeat": heartbeats, "admission": admissions})
@@ -977,6 +1105,7 @@ def main(argv=None) -> int:
     multi_host = len(hosts_rep["hosts"]) > 1
     if args.json:
         rep["hosts"] = hosts_rep
+        rep["critical_path"] = cp_rep
         rep["stall_reports"] = stalls
         rep["rollups"] = roll_rep
         rep["heartbeats"] = hb_rep
@@ -987,6 +1116,8 @@ def main(argv=None) -> int:
         print()
     else:
         print_report(rep, args.top)
+        if cp_rep:
+            print_critical_path(cp_rep)
         if roll_rep.get("windows"):
             print_rollups(roll_rep)
         if hb_rep["hosts"]:

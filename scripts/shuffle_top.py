@@ -30,11 +30,18 @@ watching a live run.
 Stdlib only (no jax / numpy, no sparkrdma_tpu import): runs on any
 machine the journal files land on.
 
+``--connect host:port`` monitors a **live daemon** over its probe
+endpoint (``ShuffleConf.probe_port``; see ``sparkrdma_tpu/obs/probe.py``)
+instead of — or in addition to — journal files: the probe's
+``/journal`` route returns the same entries the files hold, so the
+rendered tables are identical either way.
+
 Usage::
 
     python scripts/shuffle_top.py journal.jsonl            # refresh loop
     python scripts/shuffle_top.py 'j_*.jsonl' --once       # one snapshot
     python scripts/shuffle_top.py j.jsonl --interval 5 --stale 30 --wall
+    python scripts/shuffle_top.py --connect 127.0.0.1:7077 --once
 """
 
 from __future__ import annotations
@@ -43,6 +50,7 @@ import argparse
 import glob
 import json
 import os
+import socket
 import sys
 import time
 from typing import Dict, List, Optional, Tuple
@@ -96,18 +104,58 @@ def _expand(patterns: List[str]) -> List[str]:
     return out
 
 
-def collect(paths: List[str]) -> Dict[str, List[dict]]:
-    """Bucket every entry of every journal by kind (span/stall/rollup/
-    heartbeat/admission); unknown kinds are dropped (forward compat)."""
-    kinds: Dict[str, List[dict]] = {
-        "span": [], "stall": [], "rollup": [], "heartbeat": [],
-        "admission": []}
-    for path in paths:
-        for entry in load_entries(path):
-            kind = entry.get("kind") or "span"
-            if kind in kinds:
-                kinds[kind].append(entry)
+def bucket_entries(entries: List[dict],
+                   kinds: Optional[Dict[str, List[dict]]] = None
+                   ) -> Dict[str, List[dict]]:
+    """Bucket journal entries by kind (span/stall/rollup/heartbeat/
+    admission); unknown kinds are dropped (forward compat). The SAME
+    bucketing serves file entries and probe-fetched entries, which is
+    what keeps ``--connect`` output identical to the file path."""
+    if kinds is None:
+        kinds = {"span": [], "stall": [], "rollup": [], "heartbeat": [],
+                 "admission": []}
+    for entry in entries:
+        kind = entry.get("kind") or "span"
+        if kind in kinds:
+            kinds[kind].append(entry)
     return kinds
+
+
+def collect(paths: List[str],
+            connect: Optional[List[str]] = None) -> Dict[str, List[dict]]:
+    """Bucket every entry of every journal file and every ``--connect``
+    probe endpoint by kind."""
+    kinds = bucket_entries([])
+    for path in paths:
+        bucket_entries(load_entries(path), kinds)
+    for addr in connect or []:
+        bucket_entries(fetch_probe_entries(addr), kinds)
+    return kinds
+
+
+def fetch_probe_entries(addr: str) -> List[dict]:
+    """All journal entries of a live daemon via its probe endpoint's
+    ``/journal`` route (``host:port``; bare port implies localhost).
+
+    Unreachable or mid-restart daemons yield no entries rather than
+    killing the monitor, same contract as a rotated-away file.
+    """
+    host, _, port = addr.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        with socket.create_connection((host, int(port)), timeout=5.0) as c:
+            c.sendall(b"GET /journal\n")
+            buf = b""
+            while True:
+                chunk = c.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        entries = json.loads(buf.decode("utf-8"))
+    except (OSError, ValueError):
+        return []
+    return [e for e in entries if isinstance(e, dict)] \
+        if isinstance(entries, list) else []
 
 
 def span_latency_ms(s: dict) -> float:
@@ -461,9 +509,14 @@ def journal_now(kinds: Dict[str, List[dict]]) -> float:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="live monitor for sparkrdma_tpu exchange journals")
-    ap.add_argument("journals", nargs="+",
+    ap.add_argument("journals", nargs="*",
                     help="journal files (globs accepted; rotated segments "
                          "are merged automatically)")
+    ap.add_argument("--connect", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="poll a live daemon's probe endpoint "
+                         "(ShuffleConf.probe_port) instead of / besides "
+                         "journal files; repeatable for multiple hosts")
     ap.add_argument("--once", action="store_true",
                     help="render one snapshot and exit (no refresh loop)")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -477,9 +530,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="judge heartbeat staleness against the real wall "
                          "clock instead of the journal's newest timestamp")
     args = ap.parse_args(argv)
+    if not args.journals and not args.connect:
+        ap.error("give at least one journal file or --connect HOST:PORT")
 
     def snapshot() -> str:
-        kinds = collect(_expand(args.journals))
+        kinds = collect(_expand(args.journals), connect=args.connect)
         now = time.time() if args.wall else journal_now(kinds)
         return render(kinds, now, args.stale, args.rate_window)
 

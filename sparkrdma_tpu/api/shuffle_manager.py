@@ -51,8 +51,11 @@ from sparkrdma_tpu.hbm.tiered_store import TieredStore, store_totals
 from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
+from sparkrdma_tpu.obs import critical_path
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.probe import ProbeServer
+from sparkrdma_tpu.obs.tsdb import NULL_TELEMETRY, TelemetryStore
 from sparkrdma_tpu.obs.rollup import HeartbeatEmitter, RollupAggregator, span_latency_ms
 from sparkrdma_tpu.obs.timeline import (EventTimeline, scoped_active,
                                         set_active)
@@ -462,6 +465,10 @@ class ShuffleReader:
                     # of this read's exchange (per-span, not cumulative)
                     **ex.wire_stats(),
                 )
+                # schema v10: phase attribution + bottleneck verdict,
+                # derived from the drained events before sampling so
+                # the rollup observes the enriched span too
+                critical_path.enrich(span, metrics=self._m.metrics)
                 # sampling decides whether the full span lands; the
                 # rollup folds the read either way, so window totals
                 # stay exact under any journal_sample
@@ -604,7 +611,8 @@ class ShuffleManager:
                  tiered: Optional[TieredStore] = None,
                  journal: Optional[ExchangeJournal] = None,
                  admission=None,
-                 account=None):
+                 account=None,
+                 telemetry=None):
         self.runtime = runtime or MeshRuntime(conf)
         self.conf = conf or self.runtime.conf
         # Service mode (tiered= provided): this manager is a TENANT
@@ -644,6 +652,7 @@ class ShuffleManager:
         # (merged later by shuffle_report.py / shuffle_trace.py)
         if journal is not None:
             self.journal = journal       # daemon-owned, shared, not closed
+            self._sink_path = ""         # daemon's probe serves its sink
         else:
             sink = self.conf.metrics_sink
             if isinstance(sink, str) and "{process}" in sink:
@@ -652,14 +661,29 @@ class ShuffleManager:
             self.journal = ExchangeJournal(
                 sink, metrics=self.metrics,
                 max_bytes=self.conf.journal_max_bytes)
+            self._sink_path = sink if isinstance(sink, str) else ""
         # span sampling: which reads get a full journal line (the rest
         # still feed metrics + rollups; see obs.journal.SamplingPolicy)
         self.sampler = self.conf.sampling_policy()
+        # live telemetry store (obs/tsdb.py): windowed view of the
+        # registry + per-shuffle rollup history. Service mode shares the
+        # daemon-owned store (telemetry=); standalone managers own (and
+        # stop) their own. Disabled → the allocation-free null store.
+        if telemetry is not None:
+            self.telemetry = telemetry   # daemon-owned, not stopped here
+        elif (self.metrics.enabled and self.conf.telemetry_window_s > 0):
+            self.telemetry = TelemetryStore(
+                self.metrics, window_s=self.conf.telemetry_window_s,
+                history=self.conf.telemetry_history)
+            self.telemetry.start()
+        else:
+            self.telemetry = NULL_TELEMETRY
         # windowed rollups: exact per-shuffle aggregates regardless of
         # sampling, one {"kind":"rollup"} line per window
         self.rollup = (RollupAggregator(
             self.journal, window_s=self.conf.rollup_window_s,
-            process_index=self.runtime.process_index)
+            process_index=self.runtime.process_index,
+            store=(self.telemetry if self.telemetry.enabled else None))
             if self.journal.enabled and self.conf.rollup_window_s > 0
             else None)
         # liveness: reads currently executing (heartbeat + shuffle_top)
@@ -686,6 +710,26 @@ class ShuffleManager:
                         // (1 << 20)),
                 })
             self.heartbeat.start()
+        # probe endpoint (obs/probe.py): read-only wire snapshots for
+        # shuffle_top --connect. Service mode: the daemon owns THE probe
+        # (with tenant usage); standalone managers start their own.
+        # Bind failure is logged, never fatal — telemetry must not take
+        # down the shuffle it observes.
+        self.probe = None
+        if not self._service_mode and self.conf.probe_port >= 0:
+            try:
+                self.probe = ProbeServer(
+                    self.conf.probe_port,
+                    metrics=self.metrics,
+                    telemetry=self.telemetry,
+                    identity=self.runtime.process_identity(),
+                    journal_path=self._sink_path,
+                    rollups=(self.rollup.peek
+                             if self.rollup is not None else None))
+                self.probe.start()
+            except OSError:
+                log.warning("probe endpoint failed to bind port %d",
+                            self.conf.probe_port, exc_info=True)
         # per-span event timeline: events accumulate across plan+read and
         # drain into the span's `events` array at emit time
         self.timeline = EventTimeline(enabled=self.journal.enabled)
@@ -957,6 +1001,9 @@ class ShuffleManager:
             self.stats.print_histogram()
         if self.heartbeat is not None:
             self.heartbeat.stop()       # emits one final beat
+        if self.probe is not None:
+            self.probe.stop()
+            self.probe = None
         if self.rollup is not None:
             self.rollup.flush()         # close the open window
         # recycled round/output buffers (incl. the donation chain's tail)
@@ -971,6 +1018,9 @@ class ShuffleManager:
             self.tiered.delete_tenant(self.tenant)
             self._writers.clear()
             return
+        # daemon-shared telemetry is stopped by the daemon; a
+        # standalone manager owns its store
+        self.telemetry.stop()
         self.journal.close()
         self.tiered.close()
         self._writers.clear()

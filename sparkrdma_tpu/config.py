@@ -262,6 +262,25 @@ class ShuffleConf:
     #: starts. 0 (default) = never rotate. The report/trace/top CLIs
     #: and read_entries(include_rotated=True) walk all segments.
     journal_max_bytes: int = 0
+    #: live telemetry store (sparkrdma_tpu.obs.tsdb): every this many
+    #: seconds a sampler thread snapshots all scalar metrics into a
+    #: bounded ring, giving rate()/delta()/window() queries and the
+    #: probe endpoint a windowed view of the recent past. Requires the
+    #: metrics registry (collect_shuffle_read_stats or metrics_sink).
+    #: 0 (default) disables — wiring collapses to the allocation-free
+    #: null store.
+    telemetry_window_s: float = 0.0
+    #: telemetry ring capacity: samples retained per metric series and
+    #: rollup windows retained per shuffle. Memory is O(history ×
+    #: metric count); at the 120 default and a 1s window the store
+    #: remembers two minutes.
+    telemetry_history: int = 120
+    #: probe endpoint (sparkrdma_tpu.obs.probe): TCP port on which the
+    #: service/manager serves read-only JSON + Prometheus-text
+    #: snapshots (telemetry, live rollups, identity, tenant usage) to
+    #: ``shuffle_top --connect``. -1 (default) disables; 0 binds an
+    #: ephemeral port (tests — read it back from ``probe.port``).
+    probe_port: int = -1
 
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue
@@ -454,6 +473,15 @@ class ShuffleConf:
         if self.journal_max_bytes < 0:
             raise ValueError("journal_max_bytes must be >= 0 (0 = no "
                              "rotation)")
+        if self.telemetry_window_s < 0:
+            raise ValueError("telemetry_window_s must be >= 0 "
+                             "(0 disables)")
+        if self.telemetry_history < 2:
+            raise ValueError("telemetry_history must be >= 2 "
+                             "(rate/delta need two samples)")
+        if not -1 <= self.probe_port <= 65535:
+            raise ValueError("probe_port must be in [-1, 65535] "
+                             "(-1 disables, 0 = ephemeral)")
         if self.spill_tier_host_bytes < 0:
             raise ValueError("spill_tier_host_bytes must be >= 0 (0 = "
                              "evict every unpinned host segment)")

@@ -1475,7 +1475,12 @@ class ShuffleExchange:
         # failures, not construction failures — they stay on the
         # reader's retry path, never this rung.
         for attempt in (0, 1):
+            # the gate's duplicate-key sampling is host work on the
+            # exchange's critical path — timed so the attribution can
+            # charge it to the combine phase
+            self.timeline.begin("combine:gate")
             use_combine, dup_ratio = self._combine_gate(records, aggregator)
+            self.timeline.end("combine:gate")
             try:
                 out, totals, incoming = self._dispatch(
                     records, partitioner, plan, num_parts, shuffle_id,
@@ -1663,6 +1668,9 @@ class ShuffleExchange:
                 tenant=self.tenant,
                 **self.wire_stats(),
             )
+            # schema v10: phase attribution + bottleneck verdict
+            from sparkrdma_tpu.obs import critical_path
+            critical_path.enrich(span, metrics=self.metrics)
             weight = self.sampler.keep_weight(span_id, t.elapsed)
             if self.rollup is not None:
                 self.rollup.observe(span, kept=weight > 0)

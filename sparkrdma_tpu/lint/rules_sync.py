@@ -40,6 +40,12 @@ SPAN_GET = re.compile(r'\b(?:s|span)\.get\(\s*"([A-Za-z0-9_]+)"')
 ROLLUP_GET = re.compile(r'\brb\.get\(\s*"([A-Za-z0-9_]+)"')
 HEARTBEAT_GET = re.compile(r'\bhb\.get\(\s*"([A-Za-z0-9_]+)"')
 
+#: critical-path access patterns (schema v10): by convention the CLIs
+#: bind a span's ``phase_s`` dict to ``ph`` before reading phases, and
+#: bottleneck verdicts appear as ``...-bound`` string literals
+PHASE_GET = re.compile(r'\bph\.get\(\s*"([A-Za-z0-9_]+)"')
+VERDICT_LITERAL = re.compile(r'"([a-z]+-bound)"')
+
 
 def _class_ann_fields(sf: SourceFile, cls_name: str) -> Optional[Set[str]]:
     """Annotated field names of a (dataclass) class body, or None."""
@@ -82,6 +88,15 @@ def check_journal_schema_sync(ctx: LintContext) -> List[Finding]:
             if fields is not None:
                 checks.append((pattern, fields, what,
                                f"obs.rollup.{set_name}"))
+    cpath = ctx.file("sparkrdma_tpu/obs/critical_path.py")
+    if cpath is not None:
+        for set_name, pattern, what in (
+                ("PHASES", PHASE_GET, "critical-path phase"),
+                ("VERDICTS", VERDICT_LITERAL, "bottleneck verdict")):
+            names = _frozen_field_set(cpath, set_name)
+            if names is not None:
+                checks.append((pattern, names, what,
+                               f"obs.critical_path.{set_name}"))
     findings = []
     for script in SPAN_READERS:
         sf = ctx.file(f"scripts/{script}")

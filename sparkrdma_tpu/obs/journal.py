@@ -108,7 +108,12 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: ``pushdown_rows_dropped``/``pushdown_words_dropped`` (predicate /
 #: projection pushdown deltas). PER-SPAN values (not cumulative) —
 #: exchange/protocol.py §wire_stats.
-SCHEMA_VERSION = 9
+#: v10: + ``phase_s`` (critical-path phase attribution: seconds per
+#: pipeline phase, keys from obs/critical_path.py PHASES, summing to
+#: the span's wall-clock) and ``bottleneck`` (the derived verdict, one
+#: of obs/critical_path.py VERDICTS or "" when unattributed). PER-SPAN
+#: — obs/critical_path.py §enrich, called at both emission sites.
+SCHEMA_VERSION = 10
 
 
 @dataclasses.dataclass
@@ -182,6 +187,11 @@ class ExchangeSpan:
     combine_dup_ratio: float = 0.0
     pushdown_rows_dropped: int = 0
     pushdown_words_dropped: int = 0
+    # --- critical-path attribution (schema v10) — PER-SPAN: seconds
+    # per pipeline phase (obs/critical_path.py PHASES; sums to the
+    # span's wall-clock) and the derived bottleneck verdict ---
+    phase_s: Dict[str, float] = dataclasses.field(default_factory=dict)
+    bottleneck: str = ""
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

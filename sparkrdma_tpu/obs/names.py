@@ -74,6 +74,11 @@ COUNTERS = frozenset({
     "service.admission_waits",
     "service.sessions_opened",
     "service.sessions_closed",
+    "tsdb.samples",
+    "tsdb.evictions",
+    "probe.requests",
+    "probe.errors",
+    "critical_path.attributions",
 })
 
 #: Point-in-time gauges (``registry.gauge(name)``).

@@ -129,8 +129,12 @@ class RollupAggregator:
 
     def __init__(self, journal, window_s: float = 30.0,
                  process_index: int = 0,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 store=None):
         self._journal = journal
+        # optional TelemetryStore (obs/tsdb.py): every emitted rollup
+        # line is also fed into its per-shuffle history ring
+        self._store = store
         self.window_s = float(window_s)
         self.process_index = process_index
         self._clock = clock
@@ -206,6 +210,8 @@ class RollupAggregator:
         # it must happen after _lock is dropped (blocking-under-lock)
         for d in pending:
             self._journal.emit_raw(d)
+            if self._store is not None:
+                self._store.observe_rollup(d)
 
     def flush(self, now: Optional[float] = None) -> None:
         """Emit every open cell (shutdown / test hook)."""
@@ -214,6 +220,25 @@ class RollupAggregator:
             pending = self._drain_locked(now)
         for d in pending:
             self._journal.emit_raw(d)
+            if self._store is not None:
+                self._store.observe_rollup(d)
+
+    def peek(self) -> List[Dict]:
+        """Lightweight snapshot of the OPEN (not yet emitted) cells —
+        the probe endpoint's "live rollups" view. Not ROLLUP_FIELDS
+        lines: just the running counts, no histogram/derived columns."""
+        with self._lock:
+            start = self._window_start
+            return [{
+                "tenant": tenant,
+                "shuffle_id": sid,
+                "window_start": start,
+                "reads": c.reads,
+                "records": c.records,
+                "bytes": c.bytes,
+                "retries": c.retries,
+                "spills": c.spills,
+            } for (tenant, sid), c in sorted(self._cells.items())]
 
     def _roll_locked(self, now: float) -> List[Dict]:
         """Advance the window; returns drained lines to emit once the

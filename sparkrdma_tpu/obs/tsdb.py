@@ -1,0 +1,287 @@
+"""Live telemetry store — a bounded ring-buffer time-series view of the
+metrics registry.
+
+The :class:`~sparkrdma_tpu.obs.metrics.MetricsRegistry` holds cumulative
+counters and point-in-time gauges; the journal is a write-only file. The
+self-tuning loop (ROADMAP item 4) and the probe endpoint
+(:mod:`sparkrdma_tpu.obs.probe`) both need a *queryable, windowed* view
+of the recent past — "what was the spill rate over the last 30s", not
+"what is the total since process start". :class:`TelemetryStore` is that
+substrate:
+
+- a daemon thread snapshots every scalar instrument of the registry
+  (counters, gauges, gauge high-waters — the names declared in
+  :mod:`sparkrdma_tpu.obs.names`) every ``ShuffleConf.telemetry_window_s``
+  seconds into a bounded ring (``ShuffleConf.telemetry_history``
+  samples; older samples evict, counted as ``tsdb.evictions``);
+- :meth:`last` / :meth:`delta` / :meth:`rate` / :meth:`window` answer
+  point, difference, per-second and series queries over the ring;
+- per-shuffle rollup-window history: the
+  :class:`~sparkrdma_tpu.obs.rollup.RollupAggregator` feeds each emitted
+  rollup line into :meth:`observe_rollup`, so
+  :meth:`rollup_history` returns the last N windows of any (tenant,
+  shuffle) pair — exactly the per-shuffle time series an adaptive
+  planner consumes.
+
+Design constraints mirror the rest of ``obs``:
+
+1. **No-op when disabled.** The shared :data:`NULL_TELEMETRY` singleton's
+   methods are constant no-ops returning shared empties, so wiring sites
+   (rollup emission, service probes) stay unconditional and the disabled
+   path allocates nothing.
+2. **Bounded memory.** Both rings are ``deque(maxlen=...)``; memory is
+   O(history × declared metric count) regardless of uptime.
+3. **Never in the data path.** Sampling runs on its own thread against
+   the registry's lock-free snapshot; queries take a store-local lock
+   only. A telemetry failure must never take down a shuffle — the
+   sampler swallows (and counts) its own errors like the heartbeat.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("sparkrdma_tpu.tsdb")
+
+#: default ring capacity (samples retained per series and rollup
+#: windows retained per shuffle) — ShuffleConf.telemetry_history
+DEFAULT_HISTORY = 120
+
+#: shared immutable empties for the disabled path (allocation-free)
+_EMPTY_TUPLE: tuple = ()
+_EMPTY_DICT: Dict = {}
+
+
+class TelemetryStore:
+    """Bounded ring-buffer TSDB over a metrics registry (see module
+    docstring). ``start()`` launches the cadence sampler thread;
+    :meth:`sample` is also callable directly (tests, probes)."""
+
+    def __init__(self, registry, window_s: float = 1.0,
+                 history: int = DEFAULT_HISTORY,
+                 clock: Callable[[], float] = time.time):
+        if window_s < 0:
+            raise ValueError("telemetry window_s must be >= 0")
+        if history < 2:
+            raise ValueError("telemetry history must be >= 2 "
+                             "(rate/delta need two samples)")
+        self._registry = registry
+        self.window_s = float(window_s)
+        self.history = int(history)
+        self._clock = clock
+        self.enabled = True
+        self._lock = threading.Lock()
+        # ring of (ts, {name: scalar}) registry snapshots, oldest first
+        self._samples: deque = deque(maxlen=history)   # guarded-by: _lock
+        # (tenant, shuffle_id) -> ring of emitted rollup lines
+        self._rollups: Dict[Tuple[str, int], deque] = {}  # guarded-by: _lock
+        self.evicted = 0                               # guarded-by: _lock
+        self.sample_errors = 0                         # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None or self.window_s <= 0:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="sparkrdma-telemetry", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.window_s):
+            self.sample()
+
+    def sample(self, now: Optional[float] = None) -> None:  # never-raises
+        """Snapshot every scalar instrument into the ring.
+
+        Histogram sub-dicts are skipped (they are not scalar series; the
+        registry's fixed-bucket quantiles serve that need); counters,
+        gauges and gauge ``.high_water`` shadows are all kept.
+        """
+        try:
+            now = self._clock() if now is None else now
+            snap = self._registry.snapshot()
+            flat = {k: v for k, v in snap.items()
+                    if isinstance(v, (int, float))}
+            with self._lock:
+                if len(self._samples) == self._samples.maxlen:
+                    self.evicted += 1
+                    evicted = self.evicted
+                else:
+                    evicted = 0
+                self._samples.append((now, flat))
+            # registry bookkeeping OUTSIDE the store lock (leaf lock
+            # discipline); the new counts land in the NEXT sample
+            self._registry.counter("tsdb.samples").inc()
+            if evicted:
+                self._registry.counter("tsdb.evictions").inc()
+        except Exception:
+            # telemetry must never take down the process it observes
+            with self._lock:
+                self.sample_errors += 1
+                first = self.sample_errors == 1
+            if first:
+                log.exception("telemetry sample failed")
+
+    def observe_rollup(self, line: Dict) -> None:
+        """Record one emitted ``{"kind": "rollup"}`` line into the
+        per-shuffle history ring (called by the RollupAggregator)."""
+        key = (str(line.get("tenant", "") or ""),
+               int(line.get("shuffle_id", 0) or 0))
+        with self._lock:
+            ring = self._rollups.get(key)
+            if ring is None:
+                ring = self._rollups[key] = deque(maxlen=self.history)
+            ring.append(line)
+
+    # -- queries ------------------------------------------------------
+    def _points(self, name: str, span_s: Optional[float]
+                ) -> List[Tuple[float, float]]:
+        """(ts, value) points of one series, oldest first, restricted to
+        the trailing ``span_s`` seconds of the ring (all when None).
+        Caller must hold ``_lock``."""
+        pts = [(ts, flat[name]) for ts, flat
+               in self._samples if name in flat]  # srlint: ignore[guarded-by]
+        if span_s is not None and pts:
+            cutoff = pts[-1][0] - span_s
+            pts = [p for p in pts if p[0] >= cutoff]
+        return pts
+
+    def last(self, name: str):
+        """Newest sampled value of ``name`` (None before any sample)."""
+        with self._lock:
+            for ts, flat in reversed(self._samples):
+                if name in flat:
+                    return flat[name]
+        return None
+
+    def window(self, name: str, span_s: Optional[float] = None
+               ) -> List[Tuple[float, float]]:
+        """The (ts, value) series of ``name`` over the trailing
+        ``span_s`` seconds (the whole ring when None)."""
+        with self._lock:
+            return self._points(name, span_s)
+
+    def delta(self, name: str, span_s: Optional[float] = None) -> float:
+        """newest − oldest value over the window (0.0 with < 2 points).
+        Exact for counters: both endpoints are true registry values."""
+        with self._lock:
+            pts = self._points(name, span_s)
+        if len(pts) < 2:
+            return 0.0
+        return pts[-1][1] - pts[0][1]
+
+    def rate(self, name: str, span_s: Optional[float] = None) -> float:
+        """Per-second rate of change over the window (0.0 with < 2
+        points or zero elapsed time between them)."""
+        with self._lock:
+            pts = self._points(name, span_s)
+        if len(pts) < 2:
+            return 0.0
+        elapsed = pts[-1][0] - pts[0][0]
+        if elapsed <= 0:
+            return 0.0
+        return (pts[-1][1] - pts[0][1]) / elapsed
+
+    def rollup_history(self, shuffle_id: int, tenant: str = ""
+                       ) -> List[Dict]:
+        """The retained rollup-window lines of one (tenant, shuffle),
+        oldest first (empty when the shuffle emitted none yet)."""
+        with self._lock:
+            ring = self._rollups.get((tenant, int(shuffle_id)))
+            return list(ring) if ring is not None else []
+
+    def stats(self) -> Dict:
+        """JSON-ready snapshot for the probe endpoint: ring state, the
+        newest sample, and full-ring per-second rates per series."""
+        with self._lock:
+            samples = list(self._samples)
+            rollup_keys = sorted(self._rollups)
+            evicted = self.evicted
+        newest: Dict = samples[-1][1] if samples else {}
+        rates: Dict[str, float] = {}
+        if len(samples) >= 2:
+            t0, old = samples[0]
+            t1, new = samples[-1]
+            elapsed = t1 - t0
+            if elapsed > 0:
+                rates = {k: round((v - old[k]) / elapsed, 6)
+                         for k, v in new.items() if k in old}
+        return {
+            "window_s": self.window_s,
+            "history": self.history,
+            "samples": len(samples),
+            "evicted": evicted,
+            "ts": samples[-1][0] if samples else 0.0,
+            "last": dict(newest),
+            "rate": rates,
+            "rollup_series": [f"{t}/{sid}" for t, sid in rollup_keys],
+        }
+
+    # -- lifecycle ----------------------------------------------------
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, self.window_s))
+            self._thread = None
+
+
+class _NullTelemetryStore(TelemetryStore):
+    """Shared disabled singleton — constant no-ops, allocates nothing
+    (the PR-1 null-instrument pattern; queries return shared empties)."""
+
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(_NullRegistry(), window_s=0.0, history=2)
+        self.enabled = False
+
+    def start(self) -> None:
+        pass
+
+    def sample(self, now: Optional[float] = None) -> None:
+        pass
+
+    def observe_rollup(self, line: Dict) -> None:
+        pass
+
+    def last(self, name: str):
+        return None
+
+    def window(self, name: str, span_s: Optional[float] = None):
+        return _EMPTY_TUPLE
+
+    def delta(self, name: str, span_s: Optional[float] = None) -> float:
+        return 0.0
+
+    def rate(self, name: str, span_s: Optional[float] = None) -> float:
+        return 0.0
+
+    def rollup_history(self, shuffle_id: int, tenant: str = ""):
+        return _EMPTY_TUPLE
+
+    def stats(self) -> Dict:
+        return _EMPTY_DICT
+
+    def stop(self) -> None:
+        pass
+
+
+class _NullRegistry:
+    """Placeholder registry for the null store (never actually read)."""
+
+    __slots__ = ()
+
+    def snapshot(self) -> Dict:
+        return _EMPTY_DICT
+
+
+NULL_TELEMETRY = _NullTelemetryStore()
+
+
+__all__ = ["TelemetryStore", "NULL_TELEMETRY", "DEFAULT_HISTORY"]

@@ -32,6 +32,7 @@ shared across quota domains.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional
 
@@ -40,11 +41,15 @@ from sparkrdma_tpu.config import ShuffleConf
 from sparkrdma_tpu.hbm.tiered_store import TieredStore
 from sparkrdma_tpu.obs.journal import ExchangeJournal
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.probe import ProbeServer
 from sparkrdma_tpu.obs.rollup import HeartbeatEmitter
+from sparkrdma_tpu.obs.tsdb import NULL_TELEMETRY, TelemetryStore
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.service.admission import AdmissionController
 from sparkrdma_tpu.service.tenant import (TenantAccount, TenantQuota,
                                           TenantRegistry)
+
+log = logging.getLogger("sparkrdma_tpu.service")
 
 
 class ShuffleService:
@@ -64,6 +69,7 @@ class ShuffleService:
         self.journal = ExchangeJournal(
             sink, metrics=self.metrics,
             max_bytes=self.conf.journal_max_bytes)
+        self._sink_path = sink if isinstance(sink, str) else ""
         # ONE tiered store for the host: the pinned-host budget and the
         # spill directory are per-machine resources; tenants share them
         # under their accounts' quotas rather than racing blind.
@@ -102,6 +108,34 @@ class ShuffleService:
                     "tenants": self.tenants.usage_by_tenant,
                 })
             self.heartbeat.start()
+        # the daemon owns THE telemetry store and probe endpoint:
+        # sessions share them (ShuffleManager telemetry=), so one ring
+        # and one port cover every tenant. A rollup aggregator lives
+        # per session, so the probe's live-rollup view sums session
+        # peeks on demand.
+        if self.metrics.enabled and self.conf.telemetry_window_s > 0:
+            self.telemetry = TelemetryStore(
+                self.metrics, window_s=self.conf.telemetry_window_s,
+                history=self.conf.telemetry_history)
+            self.telemetry.start()
+        else:
+            self.telemetry = NULL_TELEMETRY
+        self.probe = None
+        if self.conf.probe_port >= 0:
+            try:
+                self.probe = ProbeServer(
+                    self.conf.probe_port,
+                    metrics=self.metrics,
+                    telemetry=self.telemetry,
+                    identity=self.runtime.process_identity(),
+                    journal_path=self._sink_path,
+                    rollups=self._live_rollups,
+                    tenants=self.tenants.usage_by_tenant)
+                self.probe.start()
+            except OSError:
+                # the probe must never take the daemon down with it
+                log.warning("probe endpoint failed to bind port %d",
+                            self.conf.probe_port, exc_info=True)
 
     # --- tenant lifecycle ---------------------------------------------
     def register_tenant(self, name: str,
@@ -151,7 +185,8 @@ class ShuffleService:
         m = ShuffleManager(self.runtime, conf or self.conf,
                            tenant=tenant, tiered=self.tiered,
                            journal=self.journal,
-                           admission=self.admission, account=acct)
+                           admission=self.admission, account=acct,
+                           telemetry=self.telemetry)
         with self._lock:
             self._sessions.append(m)
         self.metrics.counter("service.sessions_opened").inc()
@@ -172,6 +207,17 @@ class ShuffleService:
         with self._lock:
             sessions = list(self._sessions)
         return sum(m._reads_in_flight for m in sessions)
+
+    def _live_rollups(self) -> List[Dict]:
+        """Open (un-emitted) rollup cells across every live session —
+        the probe's live view of in-window activity."""
+        with self._lock:
+            sessions = list(self._sessions)
+        cells: List[Dict] = []
+        for m in sessions:
+            if m.rollup is not None:
+                cells.extend(m.rollup.peek())
+        return cells
 
     def usage_by_tenant(self) -> Dict[str, Dict[str, int]]:
         return self.tenants.usage_by_tenant()
@@ -199,6 +245,10 @@ class ShuffleService:
             m.stop()
         if self.heartbeat is not None:
             self.heartbeat.stop()       # emits one final beat
+        if self.probe is not None:
+            self.probe.stop()
+            self.probe = None
+        self.telemetry.stop()
         self.journal.close()
         self.tiered.close()
         self.runtime.stop()

@@ -1,0 +1,270 @@
+"""Probe endpoint (obs/probe.py) + ``shuffle_top --connect``.
+
+- wire round-trip of all three routes (``/journal`` / ``/snapshot`` /
+  ``/metrics``) against a ProbeServer wired to real obs objects;
+- the resilience contract: a client hanging up at any byte never stops
+  the server, and ``stop()`` leaves zero threads or sockets behind;
+- probe disabled by default (``probe_port=-1`` — no socket anywhere);
+- the acceptance pin: against a live two-tenant :class:`ShuffleService`
+  the ``shuffle_top --connect`` rendering is byte-identical to the
+  file-based rendering of the same journal.
+"""
+
+import importlib.util
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import ShuffleConf
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.probe import ProbeServer
+from sparkrdma_tpu.obs.tsdb import TelemetryStore
+
+REPO = Path(__file__).resolve().parent.parent
+
+# the monitor CLI is stdlib-only, so importing it in-process keeps the
+# --connect equality pin in the fast tier
+_spec = importlib.util.spec_from_file_location(
+    "shuffle_top", REPO / "scripts" / "shuffle_top.py")
+shuffle_top = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(shuffle_top)
+
+
+def fetch(port: int, request: str = "GET /snapshot\n",
+          timeout: float = 5.0) -> bytes:
+    """One raw probe exchange: send ``request``, read body to EOF."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(request.encode("utf-8"))
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return buf
+
+
+def make_server(tmp_path, journal_lines=()):
+    reg = MetricsRegistry()
+    reg.counter("shuffle.records").inc(150)
+    store = TelemetryStore(reg, window_s=0.0, history=8)
+    store.sample()
+    path = ""
+    if journal_lines:
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            for line in journal_lines:
+                f.write(json.dumps(line) + "\n")
+    srv = ProbeServer(
+        0, metrics=reg, telemetry=store,
+        identity={"process_index": 0, "host": "testhost"},
+        journal_path=path,
+        rollups=lambda: [{"tenant": "a", "shuffle_id": 1, "reads": 2}],
+        tenants=lambda: {"a": {"hbm": 1}})
+    return reg, srv
+
+
+class TestRoutes:
+    def test_snapshot_round_trip(self, tmp_path):
+        reg, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            snap = json.loads(fetch(srv.port))
+        assert snap["identity"]["host"] == "testhost"
+        assert snap["telemetry"]["last"]["shuffle.records"] == 150
+        assert snap["rollups"] == [{"tenant": "a", "shuffle_id": 1,
+                                    "reads": 2}]
+        assert snap["tenants"] == {"a": {"hbm": 1}}
+
+    def test_get_prefix_is_optional_and_default_is_snapshot(
+            self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            with_get = fetch(srv.port, "GET /snapshot\n")
+            bare = fetch(srv.port, "/snapshot\n")
+            empty = fetch(srv.port, "\n")
+        assert with_get == bare == empty
+
+    def test_journal_route_serves_file_entries(self, tmp_path):
+        lines = [{"kind": "span", "span_id": 1, "shuffle_id": 3},
+                 {"kind": "rollup", "shuffle_id": 3, "reads": 4}]
+        _, srv = make_server(tmp_path, journal_lines=lines)
+        with srv:
+            srv.start()
+            got = json.loads(fetch(srv.port, "GET /journal\n"))
+        assert got == lines
+
+    def test_journal_route_empty_without_file(self, tmp_path):
+        """The journal sink is lazy (no file until the first emit) — a
+        probe on an idle process serves [], not an error."""
+        _, srv = make_server(tmp_path)
+        srv._journal_path = str(tmp_path / "never_written.jsonl")
+        with srv:
+            srv.start()
+            assert json.loads(fetch(srv.port, "GET /journal\n")) == []
+
+    def test_metrics_prometheus_text(self, tmp_path):
+        reg, srv = make_server(tmp_path)
+        reg.histogram("shuffle.exec_s").observe(0.5)
+        with srv:
+            srv.start()
+            text = fetch(srv.port, "GET /metrics\n").decode()
+        assert "# TYPE shuffle_records gauge\nshuffle_records 150" in text
+        assert "shuffle_exec_s_count 1" in text
+        assert "shuffle_exec_s_sum 0.5" in text
+        # exposition grammar: metric names carry no dots or hyphens
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                name = line.split()[0]
+                assert "." not in name and "-" not in name
+
+    def test_unknown_path_lists_routes(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            err = json.loads(fetch(srv.port, "GET /nope\n"))
+        assert "unknown path" in err["error"]
+        assert set(err["paths"]) == {"/journal", "/snapshot", "/metrics"}
+
+    def test_request_counter(self, tmp_path):
+        reg, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            fetch(srv.port)
+            fetch(srv.port, "GET /metrics\n")
+        assert reg.counter("probe.requests").value == 2
+
+
+class TestResilience:
+    def test_killed_client_never_stops_the_server(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            # hang up immediately after the request, before the body
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            s.sendall(b"GET /journal\n")
+            s.close()
+            # hang up without even sending a request
+            socket.create_connection(("127.0.0.1", srv.port),
+                                     timeout=5.0).close()
+            # the server must still answer complete requests
+            snap = json.loads(fetch(srv.port))
+            assert "telemetry" in snap
+
+    def test_stop_leaks_nothing(self, tmp_path):
+        before = threading.active_count()
+        _, srv = make_server(tmp_path)
+        srv.start()
+        port = srv.port
+        assert json.loads(fetch(port))
+        srv.stop()
+        assert srv._thread is None
+        assert threading.active_count() <= before
+        # the listening socket is really gone
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=0.2).close()
+            except OSError:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("probe socket still accepting after stop()")
+
+    def test_ephemeral_port_is_bound(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            assert srv.port != 0
+
+    def test_bind_conflict_raises_and_leaks_no_socket(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            with pytest.raises(OSError):
+                ProbeServer(srv.port)
+
+
+class TestDisabledByDefault:
+    def test_conf_default_disables(self):
+        assert ShuffleConf().probe_port == -1
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShuffleConf(probe_port=-2)
+        with pytest.raises(ValueError):
+            ShuffleConf(probe_port=70000)
+
+
+class TestShuffleTopConnect:
+    """The acceptance pin: --connect output == file output, byte for
+    byte, against a LIVE two-tenant ShuffleService."""
+
+    def _tenant_shuffle(self, svc, tenant, sid, seed):
+        import jax
+
+        from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+
+        m = svc.open_session(tenant)
+        try:
+            mesh = m.runtime.num_partitions
+            rng = np.random.default_rng(seed)
+            x = rng.integers(0, 2**32, size=(mesh * 128,
+                                             m.conf.record_words),
+                             dtype=np.uint32)
+            h = m.register_shuffle(sid, mesh,
+                                   hash_partitioner(mesh,
+                                                    m.conf.key_words))
+            try:
+                m.get_writer(h).write(
+                    m.runtime.shard_records(x)).stop(True)
+                rows, _ = m.get_reader(h).read()
+                jax.block_until_ready(rows)
+            finally:
+                m.unregister_shuffle(sid)
+        finally:
+            svc.close_session(m)
+
+    def test_connect_render_identical_to_files(self, tmp_path):
+        from sparkrdma_tpu.service import ShuffleService
+
+        journal = str(tmp_path / "svc.jsonl")
+        conf = ShuffleConf(slot_records=256, metrics_sink=journal,
+                           probe_port=0, telemetry_window_s=0.05)
+        with ShuffleService(conf=conf) as svc:
+            assert svc.probe is not None
+            port = svc.probe.port
+            self._tenant_shuffle(svc, "tenant_a", 31, seed=1)
+            self._tenant_shuffle(svc, "tenant_b", 32, seed=2)
+
+            kinds_file = shuffle_top.collect([journal])
+            kinds_probe = shuffle_top.collect(
+                [], connect=[f"127.0.0.1:{port}"])
+
+            # both paths saw the same entries...
+            assert kinds_file == kinds_probe
+            assert len(kinds_file["span"]) >= 2
+            tenants = {s.get("tenant") for s in kinds_file["span"]}
+            assert tenants == {"tenant_a", "tenant_b"}
+
+            # ...and render byte-identical tables under the same clock
+            now = shuffle_top.journal_now(kinds_file)
+            frame_file = shuffle_top.render(kinds_file, now, 15.0, 10.0)
+            frame_probe = shuffle_top.render(kinds_probe, now, 15.0, 10.0)
+            assert frame_file == frame_probe
+            assert "tenant_a" in frame_file and "tenant_b" in frame_file
+
+    def test_unreachable_probe_yields_no_entries(self):
+        # a port nothing listens on: the monitor must keep running
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        assert shuffle_top.fetch_probe_entries(f"127.0.0.1:{port}") == []

@@ -373,6 +373,7 @@ class TestSchemaV2V3:
             "combine_dup_ratio",
             "pushdown_rows_dropped",           # v9: predicate/projection pushdown
             "pushdown_words_dropped",
+            "phase_s", "bottleneck",           # v10: critical-path attribution
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)

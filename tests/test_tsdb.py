@@ -1,0 +1,238 @@
+"""TelemetryStore unit coverage (obs/tsdb.py):
+
+- ring semantics: bounded history, eviction counting, oldest-first
+  ordering, trailing-window restriction;
+- query exactness: ``delta`` / ``rate`` against raw registry counter
+  values under an injected clock (both endpoints are true samples, so
+  the answers are exact, not estimates);
+- the disabled path: :data:`NULL_TELEMETRY` must be allocation-free —
+  every query returns the SAME shared empty object and ``start()``
+  spawns nothing;
+- per-shuffle rollup history rings (bounded, keyed by (tenant, sid));
+- the never-raises sampling contract (a poisoned registry is counted,
+  not propagated) and the cadence thread lifecycle.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.tsdb import (DEFAULT_HISTORY, NULL_TELEMETRY,
+                                    TelemetryStore)
+
+
+class FakeClock:
+    """Deterministic injectable clock: advances only when told."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_store(history=8, window_s=0.0, clock=None):
+    reg = MetricsRegistry()
+    store = TelemetryStore(reg, window_s=window_s, history=history,
+                           clock=clock or FakeClock())
+    return reg, store
+
+
+class TestRing:
+    def test_bounded_history_evicts_oldest(self):
+        reg, store = make_store(history=4)
+        clk = store._clock
+        for i in range(6):
+            reg.counter("shuffle.records").inc(10)
+            store.sample()
+            clk.tick()
+        pts = store.window("shuffle.records")
+        assert len(pts) == 4, "ring must cap at history"
+        # oldest two samples (values 10, 20) evicted; newest retained
+        assert [v for _, v in pts] == [30, 40, 50, 60]
+        assert store.evicted == 2
+        # the registry-side counters track the same story (the inc lands
+        # in the NEXT sample, so just check they exist and count)
+        assert reg.counter("tsdb.samples").value == 6
+        assert reg.counter("tsdb.evictions").value == 2
+
+    def test_window_span_restricts_to_trailing_seconds(self):
+        reg, store = make_store(history=16)
+        clk = store._clock
+        for _ in range(10):
+            reg.counter("shuffle.rounds").inc()
+            store.sample()
+            clk.tick(1.0)
+        assert len(store.window("shuffle.rounds")) == 10
+        # trailing 3s: newest point at t, cutoff t-3 -> 4 points
+        assert len(store.window("shuffle.rounds", span_s=3.0)) == 4
+
+    def test_last_and_empty_series(self):
+        reg, store = make_store()
+        assert store.last("shuffle.records") is None
+        reg.counter("shuffle.records").inc(7)
+        store.sample()
+        assert store.last("shuffle.records") == 7
+        assert store.last("no.such.series") is None
+        assert store.window("no.such.series") == []
+
+    def test_histogram_subdicts_are_skipped(self):
+        reg, store = make_store()
+        reg.histogram("shuffle.exec_s").observe(0.5)
+        reg.counter("shuffle.records").inc()
+        store.sample()
+        names = set(store.stats()["last"])
+        assert "shuffle.records" in names
+        assert not any(n.startswith("shuffle.exec_s") and "." not in n
+                       for n in names)
+        # only scalars sampled: every retained value is int/float
+        assert all(isinstance(v, (int, float))
+                   for v in store.stats()["last"].values())
+
+
+class TestQueries:
+    def test_delta_and_rate_are_exact(self):
+        """Both endpoints are true registry values — delta/rate must
+        equal the raw counter arithmetic exactly, no estimation."""
+        reg, store = make_store(history=32)
+        clk = store._clock
+        c = reg.counter("shuffle.bytes")
+        seen = []
+        for i in range(5):
+            c.inc(100 * (i + 1))      # uneven increments
+            store.sample()
+            seen.append(c.value)
+            clk.tick(2.0)
+        assert store.delta("shuffle.bytes") == seen[-1] - seen[0]
+        # 4 ticks of 2s between first and last sample
+        assert store.rate("shuffle.bytes") == \
+            (seen[-1] - seen[0]) / 8.0
+        # trailing window: last 2 samples only (newest at t, prev t-2)
+        assert store.delta("shuffle.bytes", span_s=2.0) == \
+            seen[-1] - seen[-2]
+
+    def test_fewer_than_two_points_is_zero(self):
+        reg, store = make_store()
+        assert store.delta("shuffle.records") == 0.0
+        assert store.rate("shuffle.records") == 0.0
+        reg.counter("shuffle.records").inc()
+        store.sample()
+        assert store.delta("shuffle.records") == 0.0
+        assert store.rate("shuffle.records") == 0.0
+
+    def test_zero_elapsed_rate_is_zero(self):
+        reg, store = make_store()
+        reg.counter("shuffle.records").inc()
+        store.sample()
+        reg.counter("shuffle.records").inc()
+        store.sample()            # same injected clock instant
+        assert store.rate("shuffle.records") == 0.0
+
+    def test_stats_shape(self):
+        reg, store = make_store(history=4, window_s=0.0)
+        reg.counter("shuffle.records").inc(5)
+        store.sample()
+        store._clock.tick()
+        reg.counter("shuffle.records").inc(5)
+        store.sample()
+        s = store.stats()
+        assert s["history"] == 4 and s["samples"] == 2
+        assert s["last"]["shuffle.records"] == 10
+        assert s["rate"]["shuffle.records"] == 5.0
+        assert s["rollup_series"] == []
+
+
+class TestRollupHistory:
+    def test_bounded_per_shuffle_rings(self):
+        _, store = make_store(history=4)
+        for i in range(10):
+            store.observe_rollup({"kind": "rollup", "tenant": "a",
+                                  "shuffle_id": 7, "window_start": i})
+        got = store.rollup_history(7, tenant="a")
+        assert [w["window_start"] for w in got] == [6, 7, 8, 9]
+
+    def test_keyed_by_tenant_and_shuffle(self):
+        _, store = make_store()
+        store.observe_rollup({"tenant": "a", "shuffle_id": 1, "reads": 1})
+        store.observe_rollup({"tenant": "b", "shuffle_id": 1, "reads": 2})
+        store.observe_rollup({"shuffle_id": 2, "reads": 3})   # no tenant
+        assert store.rollup_history(1, tenant="a")[0]["reads"] == 1
+        assert store.rollup_history(1, tenant="b")[0]["reads"] == 2
+        assert store.rollup_history(2)[0]["reads"] == 3
+        assert store.rollup_history(9) == []
+        assert sorted(store.stats()["rollup_series"]) == \
+            ["/2", "a/1", "b/1"]
+
+
+class TestDisabledPath:
+    def test_null_store_is_allocation_free(self):
+        """Every query on the shared null singleton returns the SAME
+        shared empty object — the disabled path allocates nothing."""
+        n = NULL_TELEMETRY
+        assert n.enabled is False
+        assert n.window("a") is n.window("b")
+        assert n.window("a") is n.rollup_history(1)
+        assert n.stats() is n.stats()
+        assert n.last("x") is None
+        assert n.delta("x") == 0.0 and n.rate("x") == 0.0
+
+    def test_null_store_noops(self):
+        n = NULL_TELEMETRY
+        n.sample()
+        n.observe_rollup({"tenant": "t", "shuffle_id": 1})
+        n.start()
+        assert n._thread is None, "null start() must spawn nothing"
+        assert n.rollup_history(1) == ()
+        n.stop()
+
+
+class TestLifecycle:
+    def test_validation(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            TelemetryStore(reg, window_s=-1.0)
+        with pytest.raises(ValueError):
+            TelemetryStore(reg, history=1)
+
+    def test_zero_window_never_starts_thread(self):
+        _, store = make_store(window_s=0.0)
+        store.start()
+        assert store._thread is None
+        store.stop()
+
+    def test_cadence_thread_samples_and_joins(self):
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.005, history=DEFAULT_HISTORY)
+        reg.counter("shuffle.records").inc()
+        before = threading.active_count()
+        store.start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and \
+                    store.last("shuffle.records") is None:
+                time.sleep(0.005)
+            assert store.last("shuffle.records") == 1
+        finally:
+            store.stop()
+        assert store._thread is None
+        assert threading.active_count() <= before
+
+    def test_sample_never_raises(self):
+        class PoisonRegistry:
+            def snapshot(self):
+                raise RuntimeError("boom")
+
+            def counter(self, name):
+                raise RuntimeError("boom")
+
+        store = TelemetryStore(PoisonRegistry(), window_s=0.0)
+        store.sample()            # must swallow, not propagate
+        store.sample()
+        assert store.sample_errors == 2
+        assert store.window("anything") == []
