@@ -54,9 +54,18 @@ before any fabric traffic (BENCH_COMBINE_RECORDS sizes it).
 
 A ``telemetry_overhead`` A/B leg also runs on every backend: the same
 small TeraSort exchange with the live telemetry store sampling at 50ms
-vs. disabled, min-of-N interleaved trials, reporting ``overhead_pct``
-and an ``ok`` flag against the 1% budget (BENCH_TELEMETRY_RECORDS /
-BENCH_TELEMETRY_TRIALS size it). With ``--journal`` every leg's stats
+(plus the alert evaluator at the same cadence) vs. disabled, min-of-N
+interleaved trials, reporting ``overhead_pct`` and an ``ok`` flag
+against the 1% budget (BENCH_TELEMETRY_RECORDS /
+BENCH_TELEMETRY_TRIALS size it).
+
+Regression gate: set BENCH_BASELINE_DIR to a directory and every leg's
+GB/s is judged against the persisted cross-run baseline
+(obs/baseline.py median/MAD EWMA, keyed by mesh geometry) BEFORE this
+run's numbers are folded in — the JSON grows a ``regression_gate``
+section with per-leg ``{baseline, delta_pct, regressed}`` verdicts;
+``regressed`` means more than BENCH_REGRESS_PCT (default 10) percent
+below baseline. With ``--journal`` every leg's stats
 also embed ``critical_path`` — the newest span's ``bottleneck`` verdict
 and top-3 attributed phases (schema v10, ``obs.critical_path``).
 
@@ -102,6 +111,50 @@ def _critical_path_summary(journal: str):
         "bottleneck": span.get("bottleneck", ""),
         "top_phases": [{"phase": p, "seconds": round(float(s), 6)}
                        for p, s in top],
+    }
+
+
+def _regression_gate(legs: dict, baseline_dir: str, regress_pct: float,
+                     geometry: str) -> dict:
+    """Per-leg regression verdicts against the persisted cross-run
+    baseline (obs/baseline.py median/MAD EWMA under BENCH_BASELINE_DIR,
+    keyed by mesh geometry so a topology change never reads as a
+    regression).
+
+    Each leg with a throughput number gets ``{"baseline", "delta_pct",
+    "regressed"}``: ``regressed`` is true when the leg scored more than
+    ``regress_pct`` percent BELOW the persisted baseline median. A leg
+    with no baseline yet seeds one and is never flagged (``baseline``
+    and ``delta_pct`` null). The run's observations are folded in and
+    saved AFTER the comparison, so a regressed run is judged against
+    history, not against itself.
+    """
+    from sparkrdma_tpu.obs.baseline import BaselineStore
+
+    store = BaselineStore(baseline_dir)
+    verdicts = {}
+    for leg in sorted(legs):
+        gbps = legs[leg]
+        if gbps is None or gbps <= 0:
+            continue
+        ent = store.get(f"bench.{leg}_gbps", geometry=geometry)
+        baseline = ent["median"] if ent else None
+        delta_pct = (round((gbps / baseline - 1.0) * 100.0, 3)
+                     if baseline else None)
+        verdicts[leg] = {
+            "baseline": round(baseline, 3) if baseline else None,
+            "delta_pct": delta_pct,
+            "regressed": (delta_pct is not None
+                          and delta_pct < -regress_pct),
+        }
+        store.observe(f"bench.{leg}_gbps", gbps, geometry=geometry)
+    store.save()
+    return {
+        "baseline_dir": baseline_dir,
+        "regress_pct": regress_pct,
+        "geometry": geometry,
+        "legs": verdicts,
+        "regressed": any(v["regressed"] for v in verdicts.values()),
     }
 
 
@@ -433,7 +486,11 @@ def run_telemetry_overhead(records_per_device: int, repeats: int,
             pack_sort_min_payload=0,
             wide_sort_min_payload=0,
             metrics_sink=os.path.join(tmp, "telemetry_ab.jsonl"),
-            telemetry_window_s=0.05 if store_on else 0.0)
+            telemetry_window_s=0.05 if store_on else 0.0,
+            # the alert evaluator rides the "on" arm at the same
+            # aggressive cadence, so the 1% budget covers rule
+            # evaluation + baseline folding, not just sampling
+            alert_eval_s=0.05 if store_on else 0.0)
         manager = ShuffleManager(MeshRuntime(conf), conf)
         try:
             res, _, _ = run_terasort(manager, records_per_device=n,
@@ -507,14 +564,21 @@ def main(argv=None) -> int:
             return 1
         if args.journal:
             metrics["critical_path"] = _critical_path_summary(args.journal)
-        print(json.dumps({
+        single = {
             "metric": "terasort_shuffle_gbps_per_chip",
             "value": round(gbps, 3),
             "unit": "GB/s/chip",
             "vs_baseline": round(gbps / baseline_gbps, 3),
             "record_bytes": int(explicit_words) * 4,
             "metrics": metrics,
-        }))
+        }
+        baseline_dir = os.environ.get("BENCH_BASELINE_DIR", "")
+        if baseline_dir:
+            single["regression_gate"] = _regression_gate(
+                {f"w{explicit_words}": gbps}, baseline_dir,
+                float(os.environ.get("BENCH_REGRESS_PCT", 10.0)),
+                geometry=f"w{len(jax.devices())}")
+        print(json.dumps(single))
         return 0
 
     # faithful HiBench width (100B) is the judged number; the width-curve
@@ -634,6 +698,22 @@ def main(argv=None) -> int:
             f"backend is {jax.default_backend()!r}, not tpu — two "
             "tenants on a CPU mesh measure thread scheduling, not "
             "shared-HBM fairness")
+    # regression gate (BENCH_BASELINE_DIR): judge each leg against the
+    # persisted cross-run baseline, then fold this run in
+    baseline_dir = os.environ.get("BENCH_BASELINE_DIR", "")
+    if baseline_dir:
+        legs = {
+            "faithful": faithful,
+            "width_optimal": optimal,
+            "combine_rbk": combine_gbps,
+            "ring_fused": out.get("terasort_ring_fused_gbps_per_chip"),
+            "oversub": out.get("terasort_oversub_gbps_per_chip"),
+            "multitenant": out.get("multitenant_gbps_per_chip"),
+        }
+        out["regression_gate"] = _regression_gate(
+            legs, baseline_dir,
+            float(os.environ.get("BENCH_REGRESS_PCT", 10.0)),
+            geometry=f"w{len(jax.devices())}")
     print(json.dumps(out))
     return 0
 

@@ -48,13 +48,22 @@ seed replays exactly.
    pure wire-size optimization — retries that replay a combined
    dispatch must never change what the reader aggregates to.
 
+5. **Alerting end-to-end** — a chaos arm (transient dispatch faults
+   with fat retry backoff + a starved host spill tier) must make the
+   live :class:`AlertEvaluator` fire and journal ``spill_storm`` and
+   ``straggler_spread`` alerts, visible over the wire at the probe's
+   ``/alerts`` endpoint AND surfaced as first-class evidence by
+   ``shuffle_report --doctor``; an identical fault-free control arm
+   with an ample host tier must fire exactly zero alerts.
+
 Usage (CPU host, 8 simulated devices)::
 
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py --seed 7
 
 Exit 0: all legs bit-identical, >= 6 sites hit, books balanced, the
-two-tenant leg's clean tenant untouched by the noisy one's faults, and
-the combine-on chaos leg bitwise equal to its combine-off control.
+two-tenant leg's clean tenant untouched by the noisy one's faults,
+the combine-on chaos leg bitwise equal to its combine-off control,
+and the alert leg's chaos-fires/control-quiet verdict holding.
 Prints one JSON summary line (plus per-leg progress on stderr).
 """
 
@@ -452,6 +461,161 @@ def run_combine_leg(args, common: dict, tmp: str) -> dict:
     }
 
 
+def run_alert_leg(args, common: dict, tmp: str) -> dict:
+    """Alerting E2E: chaos must fire and journal spill + straggler
+    alerts — surfaced by the probe's ``/alerts`` AND by
+    ``shuffle_report --doctor``'s alert evidence — while an identical
+    fault-free control arm fires none.
+
+    Both arms run the same two-phase workload with the live evaluator
+    wired (telemetry sampling fast; evaluation driven deterministically
+    through ``evaluate_once`` so the verdict never races a wall-clock
+    thread):
+
+    - a repeated-read shuffle where the chaos arm's injected dispatch
+      delay makes the first read dwarf the rest (one rollup window with
+      ``lat_max >> mean`` -> the ``straggler_spread`` rule), while the
+      control arm's reads are uniform;
+    - a tiered-store TeraSort whose host budget is TINY in the chaos
+      arm (chunks cycle to disk -> ``store.spill_bytes`` moves -> the
+      ``spill_storm`` rule) and ample in the control arm (no spill).
+
+    Compile time must not masquerade as a straggler: program caches are
+    per-manager, so each arm warms its OWN manager up with a separate
+    warm-up shuffle (few reads — below the straggler rule's minimum)
+    while its fault plane is still disabled, and the chaos schedule is
+    installed via ``faults.set_active_plane`` only around the measured
+    reads.
+    """
+    import subprocess
+    import time as _time
+
+    import numpy as np
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf, faults
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+    from sparkrdma_tpu.workloads.streaming import run_tiered_terasort
+
+    rpd = max(args.records_per_device // 4, 256)
+    chunk = max(rpd // 2, 128)
+    rng = np.random.default_rng(args.seed + 40)
+
+    def arm(name, chaos):
+        journal = os.path.join(tmp, f"alert_{name}.jsonl")
+        kw = dict(common)
+        seg_bytes = chunk * 9 * 4            # record_words columns, u32
+        conf = ShuffleConf(
+            spill_dir=os.path.join(tmp, f"alert_{name}_spill"),
+            spill_tier_dir=os.path.join(tmp, f"alert_{name}_tier"),
+            # chaos: ~2 of 4 chunks host-resident, the rest cycle disk
+            spill_tier_host_bytes=(2 * seg_bytes if chaos else 1 << 30),
+            spill_tier_prefetch=1,
+            metrics_sink=journal,
+            probe_port=0,
+            telemetry_window_s=0.05,
+            rollup_window_s=2.0,
+            alert_eval_s=3600.0,        # thread parked: evaluate_once drives
+            alert_fire_breaches=1,
+            alert_resolve_windows=1000,  # alerts stay active for /alerts
+            **kw)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        fired = []
+        probe_alerts = []
+        try:
+            w = m.conf.record_words
+            mesh = m.runtime.num_partitions
+            part = hash_partitioner(mesh, m.conf.key_words)
+            x = rng.integers(0, 2**32, size=(mesh * rpd, w),
+                             dtype=np.uint32)
+
+            # warm-up shuffle: absorbs the exchange compile. 3 reads is
+            # below the straggler rule's 4-read minimum, so this series
+            # can never breach — and the fault plane is not installed
+            # yet, so no fault budget is consumed here.
+            hw = m.register_shuffle(40, mesh, part)
+            m.get_writer(hw).write(m.runtime.shard_records(x)).stop(True)
+            for _ in range(3):
+                m.get_reader(hw).read()
+            m.unregister_shuffle(40)
+
+            h = m.register_shuffle(43, mesh, part)
+            m.get_writer(h).write(m.runtime.shard_records(x)).stop(True)
+            reader = m.get_reader(h)
+            # a DELAY fault, not a fail: retry backoff is deliberately
+            # excluded from span latency (exec_s times only the winning
+            # attempt), so only time spent inside the dispatch itself
+            # can show up as a straggler — exactly what a slow peer
+            # looks like in production
+            plane = faults.FaultPlane(
+                "exchange.dispatch:delay=300ms@attempt<1" if chaos
+                else "")
+            prev = faults.set_active_plane(plane if chaos else None)
+            try:
+                # recorded reads: only those feed the rollup windows the
+                # straggler rule consumes. Chaos: the first read eats the
+                # injected 300ms stall, the rest run clean, so ONE
+                # window shows lat_max >> median.
+                for _ in range(13):
+                    reader.read()
+            finally:
+                faults.set_active_plane(prev)
+            run_tiered_terasort(m, np.ascontiguousarray(
+                rng.integers(0, 2**32, size=(w, 4 * chunk),
+                             dtype=np.uint32)),
+                chunk_records=chunk, collect=False, shuffle_id_base=960)
+
+            # close the read phase's rollup window, give the 50ms
+            # sampler a couple of ticks, then evaluate deterministically
+            _time.sleep(conf.rollup_window_s + 0.3)
+            reader.read()                     # emits the old window
+            _time.sleep(0.15)
+            for _ in range(2):
+                fired.extend(m.alerts.evaluate_once())
+            m.unregister_shuffle(43)
+            if m.probe is not None:
+                try:
+                    probe_alerts = probe_fetch(
+                        m.probe.port, "/alerts").get("alerts", [])
+                except (OSError, ValueError):
+                    pass
+        finally:
+            m.stop()
+        return journal, fired, probe_alerts
+
+    journal_x, fired_x, probe_x = arm("chaos", chaos=True)
+    _, fired_c, probe_c = arm("control", chaos=False)
+
+    # the journal is closed now: --doctor must surface the alert lines
+    # as first-class evidence (the subprocess IS the operator workflow)
+    report = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "shuffle_report.py")
+    doc = subprocess.run([sys.executable, report, journal_x, "--doctor"],
+                         capture_output=True, text=True)
+    doctor_alerts = [ln for ln in doc.stdout.splitlines()
+                     if "ALERT " in ln]
+
+    chaos_rules = sorted({al.get("rule") for al in fired_x
+                          if al.get("event") == "fired"})
+    probe_rules = sorted({al.get("rule") for al in probe_x})
+    control_rules = sorted({al.get("rule") for al in fired_c})
+    ok = ("spill_storm" in chaos_rules
+          and "straggler_spread" in chaos_rules
+          and "spill_storm" in probe_rules
+          and "straggler_spread" in probe_rules
+          and bool(doctor_alerts)
+          and not fired_c and not probe_c)
+    return {
+        "ok": ok,
+        "chaos_fired_rules": chaos_rules,
+        "chaos_probe_rules": probe_rules,
+        "doctor_alert_lines": len(doctor_alerts),
+        "control_fired": len(fired_c),
+        "control_rules": control_rules,
+        "control_probe_alerts": len(probe_c),
+    }
+
+
 def outputs_equal(a, b) -> bool:
     import numpy as np
 
@@ -570,12 +734,18 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         combine_leg = run_combine_leg(args, common, tmp)
 
+        # --- alerting pass (fresh accounting) --------------------------
+        faults.reset_accounting()
+        print("alert pass: chaos fires spill+straggler, control stays "
+              "quiet...", file=sys.stderr, flush=True)
+        alert_leg = run_alert_leg(args, common, tmp)
+
     identical = {leg: outputs_equal(control[leg], chaos[leg])
                  for leg in control}
     sites = plane.sites_hit()
     ok = (all(identical.values()) and len(sites) >= 6 and books
           and not spans_missing_backoff and tenant_leg["ok"]
-          and combine_leg["ok"])
+          and combine_leg["ok"] and alert_leg["ok"])
 
     print(json.dumps({
         "ok": ok,
@@ -593,6 +763,7 @@ def main(argv=None) -> int:
         "bit_identical": identical,
         "tenant_leg": tenant_leg,
         "combine_leg": combine_leg,
+        "alert_leg": alert_leg,
     }, default=str))
     return 0 if ok else 1
 

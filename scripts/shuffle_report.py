@@ -33,6 +33,9 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
   dispatch / queue-block / spill / admission-wait / other), the
   dominant ``bottleneck`` verdict per shuffle, and the cross-host
   straggler delta on multi-journal merges;
+- alert evidence (schema v11, ``{"kind": "alert"}`` lines): the live
+  evaluator's fired/resolved verdicts, which ``--doctor`` reports
+  AHEAD of its own heuristics — the evaluator saw the breach happen;
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
   stalls, retries, combinable-but-uncombined shuffles, bottleneck
   verdicts) to the ShuffleConf knob that addresses them.
@@ -108,7 +111,7 @@ def split_kinds(entries: List[dict]) -> Dict[str, List[dict]]:
     compat: a v4 journal must not break a v3 report)."""
     out: Dict[str, List[dict]] = {
         "span": [], "stall": [], "rollup": [], "heartbeat": [],
-        "admission": []}
+        "admission": [], "alert": []}
     for e in entries:
         k = e.get("kind") or "span"
         if k in out:
@@ -679,9 +682,63 @@ def _sync_fetch_shuffles(spans: List[dict]) -> Dict[int, int]:
     return blocked
 
 
-def diagnose(spans: List[dict], stalls: List[dict]) -> List[str]:
-    """Rule-based symptom -> knob mapping (the --doctor section)."""
+def _alert_evidence(alerts: Sequence[dict]) -> List[str]:
+    """Doctor lines from journaled ``alert`` lines (schema v11).
+
+    One line per (rule, dedup) key, worst severity first: how often it
+    fired, whether it is still active (no ``resolved`` after the last
+    ``fired``), and the evaluator's own message for the last event.
+    """
+    sev_rank = {"info": 0, "warn": 1, "crit": 2}
+    state: Dict[Tuple[str, str], dict] = {}
+    for al in sorted(alerts, key=lambda e: float(e.get("ts", 0.0) or 0.0)):
+        key = (str(al.get("rule", "") or ""),
+               str(al.get("dedup", "") or ""))
+        st = state.setdefault(key, {"fired": 0, "active": False,
+                                    "last": al})
+        if al.get("event") == "fired":
+            st["fired"] += 1
+            st["active"] = True
+            st["last"] = al
+        elif al.get("event") == "resolved":
+            st["active"] = False
+            st["last"] = al
+    out: List[str] = []
+    ordered = sorted(
+        state.items(),
+        key=lambda kv: (-sev_rank.get(
+            str(kv[1]["last"].get("severity", "") or ""), 0),
+            not kv[1]["active"], kv[0]))
+    for (rule_id, dedup), st in ordered:
+        if not st["fired"]:
+            continue   # resolve-only tail of a rotated-away fire
+        al = st["last"]
+        name = f"{rule_id}[{dedup}]" if dedup else rule_id
+        sev = str(al.get("severity", "") or "?")
+        sub = str(al.get("subsystem", "") or "?")
+        status = ("STILL ACTIVE" if st["active"]
+                  else "fired, later resolved")
+        msg = str(al.get("message", "") or "")
+        tenant = str(al.get("tenant", "") or "")
+        who = f" (tenant {tenant!r})" if tenant else ""
+        out.append(
+            f"ALERT {name} [{sev}/{sub}] {status}, "
+            f"{st['fired']} firing(s){who}: {msg} — the live evaluator "
+            "journaled this as it happened; treat it as ground truth "
+            "over the reconstructions below")
+    return out
+
+
+def diagnose(spans: List[dict], stalls: List[dict],
+             alerts: Sequence[dict] = ()) -> List[str]:
+    """Rule-based symptom -> knob mapping (the --doctor section).
+
+    Journaled ``alert`` lines are first-class evidence, reported AHEAD
+    of the heuristics: the live evaluator saw the breach as it
+    happened (with hysteresis), so its verdicts outrank the doctor's
+    after-the-fact reconstruction from spans."""
     findings: List[str] = []
+    findings.extend(_alert_evidence(alerts))
     skewed = sorted({int(s.get("shuffle_id", -1)) for s in spans
                      if span_skew(s) > DOCTOR_SKEW_THRESHOLD})
     if skewed:
@@ -1086,6 +1143,7 @@ def main(argv=None) -> int:
     rollups: List[dict] = []
     heartbeats: List[dict] = []
     admissions: List[dict] = []
+    alerts: List[dict] = []
     for path in args.journals:
         kinds = split_kinds(load_entries(path))
         spans.extend(kinds["span"])
@@ -1093,6 +1151,7 @@ def main(argv=None) -> int:
         rollups.extend(kinds["rollup"])
         heartbeats.extend(kinds["heartbeat"])
         admissions.extend(kinds["admission"])
+        alerts.extend(kinds["alert"])
     rep = aggregate(spans)
     cp_rep = critical_path_report(spans)
     tenant_rep = tenant_breakdown({
@@ -1111,7 +1170,7 @@ def main(argv=None) -> int:
         rep["heartbeats"] = hb_rep
         rep["tenants"] = tenant_rep["tenants"]
         if args.doctor:
-            rep["doctor"] = diagnose(spans, stalls)
+            rep["doctor"] = diagnose(spans, stalls, alerts)
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
@@ -1130,7 +1189,7 @@ def main(argv=None) -> int:
             print_stalls(stalls)
         if args.doctor:
             print("doctor:")
-            for line in diagnose(spans, stalls):
+            for line in diagnose(spans, stalls, alerts):
                 print(f"  - {line}")
     return 0
 

@@ -108,12 +108,12 @@ def bucket_entries(entries: List[dict],
                    kinds: Optional[Dict[str, List[dict]]] = None
                    ) -> Dict[str, List[dict]]:
     """Bucket journal entries by kind (span/stall/rollup/heartbeat/
-    admission); unknown kinds are dropped (forward compat). The SAME
-    bucketing serves file entries and probe-fetched entries, which is
-    what keeps ``--connect`` output identical to the file path."""
+    admission/alert); unknown kinds are dropped (forward compat). The
+    SAME bucketing serves file entries and probe-fetched entries, which
+    is what keeps ``--connect`` output identical to the file path."""
     if kinds is None:
         kinds = {"span": [], "stall": [], "rollup": [], "heartbeat": [],
-                 "admission": []}
+                 "admission": [], "alert": []}
     for entry in entries:
         kind = entry.get("kind") or "span"
         if kind in kinds:
@@ -122,40 +122,61 @@ def bucket_entries(entries: List[dict],
 
 
 def collect(paths: List[str],
-            connect: Optional[List[str]] = None) -> Dict[str, List[dict]]:
+            connect: Optional[List[str]] = None,
+            probe_status: Optional[Dict[str, bool]] = None
+            ) -> Dict[str, List[dict]]:
     """Bucket every entry of every journal file and every ``--connect``
-    probe endpoint by kind."""
+    probe endpoint by kind. ``probe_status`` (when given) records per
+    endpoint whether this poll actually reached it — the monitor loop's
+    STALE-banner input."""
     kinds = bucket_entries([])
     for path in paths:
         bucket_entries(load_entries(path), kinds)
     for addr in connect or []:
-        bucket_entries(fetch_probe_entries(addr), kinds)
+        bucket_entries(fetch_probe_entries(addr, status=probe_status),
+                       kinds)
     return kinds
 
 
-def fetch_probe_entries(addr: str) -> List[dict]:
+def fetch_probe_entries(addr: str, retries: int = 2,
+                        backoff_s: float = 0.25,
+                        status: Optional[Dict[str, bool]] = None
+                        ) -> List[dict]:
     """All journal entries of a live daemon via its probe endpoint's
     ``/journal`` route (``host:port``; bare port implies localhost).
 
-    Unreachable or mid-restart daemons yield no entries rather than
-    killing the monitor, same contract as a rotated-away file.
+    A daemon restarting mid-poll drops the connection or serves a
+    truncated body; each attempt is retried up to ``retries`` times
+    with doubling ``backoff_s`` sleeps before giving up. Unreachable
+    daemons still yield no entries rather than killing the monitor
+    (same contract as a rotated-away file); ``status[addr]`` records
+    whether any attempt succeeded so the caller can flag staleness.
     """
     host, _, port = addr.rpartition(":")
     host = host or "127.0.0.1"
-    try:
-        with socket.create_connection((host, int(port)), timeout=5.0) as c:
-            c.sendall(b"GET /journal\n")
-            buf = b""
-            while True:
-                chunk = c.recv(65536)
-                if not chunk:
-                    break
-                buf += chunk
-        entries = json.loads(buf.decode("utf-8"))
-    except (OSError, ValueError):
-        return []
-    return [e for e in entries if isinstance(e, dict)] \
-        if isinstance(entries, list) else []
+    for attempt in range(max(0, retries) + 1):
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=5.0) as c:
+                c.sendall(b"GET /journal\n")
+                buf = b""
+                while True:
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            entries = json.loads(buf.decode("utf-8"))
+        except (OSError, ValueError):
+            if attempt < retries:
+                time.sleep(backoff_s * (2 ** attempt))
+            continue
+        if status is not None:
+            status[addr] = True
+        return [e for e in entries if isinstance(e, dict)] \
+            if isinstance(entries, list) else []
+    if status is not None:
+        status[addr] = False
+    return []
 
 
 def span_latency_ms(s: dict) -> float:
@@ -432,6 +453,23 @@ def build_tenant_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
     return [tenants[k] for k in sorted(tenants)]
 
 
+def build_alert_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
+    """Currently-active alerts replayed from journaled ``alert`` lines:
+    per (rule, dedup) key the newest ``fired`` not followed by a
+    ``resolved``. Works identically on files and ``--connect`` probe
+    entries (the probe's /journal carries the same lines)."""
+    state: Dict[Tuple[str, str], dict] = {}
+    for al in sorted(kinds.get("alert", []),
+                     key=lambda e: float(e.get("ts", 0.0) or 0.0)):
+        key = (str(al.get("rule", "") or ""),
+               str(al.get("dedup", "") or ""))
+        if al.get("event") == "fired":
+            state[key] = al
+        elif al.get("event") == "resolved":
+            state.pop(key, None)
+    return [state[k] for k in sorted(state)]
+
+
 def render(
     kinds: Dict[str, List[dict]],
     now: float,
@@ -448,7 +486,8 @@ def render(
         f"shuffle_top — {len(hosts)} host(s), {len(shuffles)} shuffle(s), "
         f"{n_spans} spans{sampled}, {len(kinds['rollup'])} rollup window(s), "
         f"{len(kinds['stall'])} stall(s), "
-        f"{len(kinds.get('admission', []))} admission wait(s)")
+        f"{len(kinds.get('admission', []))} admission wait(s), "
+        f"{len(kinds.get('alert', []))} alert line(s)")
     lines.append("")
     lines.append(f"{'HOST':>4}  {'NAME':<14} {'PID':>7} {'HB AGE':>7} "
                  f"{'INFL':>4} {'POOL':>4} {'RSS':>8} {'READS/S':>8} "
@@ -494,6 +533,25 @@ def render(
                 f"{_fmt_bytes(float(c['host'])):>10} "
                 f"{_fmt_bytes(float(c['disk'])):>10} "
                 f"{c['waits']:>6} {c['wait_ms']:>9.1f}")
+    alerts = build_alert_rows(kinds)
+    if alerts:
+        lines.append("")
+        lines.append(f"{'ALERT':<24} {'SEV':<5} {'SUBSYS':<9} "
+                     f"{'TENANT':<10} {'VALUE':>10} {'AGE':>7}  MESSAGE")
+        for al in alerts:
+            age = max(0.0, now - float(al.get("ts", 0.0) or 0.0))
+            rule_id = str(al.get("rule", "") or "")
+            dedup = str(al.get("dedup", "") or "")
+            name = f"{rule_id}:{dedup}" if dedup else rule_id
+            tenant = str(al.get("tenant", "") or "") or "-"
+            lines.append(
+                f"{name[:24]:<24} "
+                f"{str(al.get('severity', '') or '')[:5]:<5} "
+                f"{str(al.get('subsystem', '') or '')[:9]:<9} "
+                f"{tenant[:10]:<10} "
+                f"{float(al.get('value', 0.0) or 0.0):>10.2f} "
+                f"{_fmt_age(age):>7}  "
+                f"{str(al.get('message', '') or '')}")
     return "\n".join(lines)
 
 
@@ -533,17 +591,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.journals and not args.connect:
         ap.error("give at least one journal file or --connect HOST:PORT")
 
+    probe_status: Dict[str, bool] = {}
+
     def snapshot() -> str:
-        kinds = collect(_expand(args.journals), connect=args.connect)
+        probe_status.clear()
+        kinds = collect(_expand(args.journals), connect=args.connect,
+                        probe_status=probe_status)
         now = time.time() if args.wall else journal_now(kinds)
         return render(kinds, now, args.stale, args.rate_window)
 
+    def stale_banner() -> str:
+        down = sorted(a for a, ok in probe_status.items() if not ok)
+        if not down:
+            return ""
+        return ("*** STALE: probe endpoint(s) unreachable: "
+                + ", ".join(down) + " — retrying ***")
+
     if args.once:
-        print(snapshot())
+        frame = snapshot()
+        banner = stale_banner()
+        print(banner + "\n" + frame if banner else frame)
         return 0
+    # a daemon restart mid-poll must not blank the view: keep the last
+    # good frame and flag it STALE until the probe answers again
+    last_good = ""
     try:
         while True:
             frame = snapshot()
+            banner = stale_banner()
+            if banner:
+                frame = banner + "\n" + (last_good or frame)
+            else:
+                last_good = frame
             # ANSI clear + home: a real refresh, not an endless scroll
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
             sys.stdout.flush()

@@ -52,8 +52,10 @@ from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.obs import critical_path
+from sparkrdma_tpu.obs.alerts import AlertEvaluator
+from sparkrdma_tpu.obs.baseline import BaselineStore
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
-from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.metrics import MetricsRegistry, global_registry
 from sparkrdma_tpu.obs.probe import ProbeServer
 from sparkrdma_tpu.obs.tsdb import NULL_TELEMETRY, TelemetryStore
 from sparkrdma_tpu.obs.rollup import HeartbeatEmitter, RollupAggregator, span_latency_ms
@@ -672,9 +674,14 @@ class ShuffleManager:
         if telemetry is not None:
             self.telemetry = telemetry   # daemon-owned, not stopped here
         elif (self.metrics.enabled and self.conf.telemetry_window_s > 0):
+            # fold the process-global registry into every sample: the
+            # tiered store / staging / degradation ladders record there
+            # (store.*, staging.*, degrade.*), and the alert rules that
+            # watch those series query THIS store
             self.telemetry = TelemetryStore(
                 self.metrics, window_s=self.conf.telemetry_window_s,
-                history=self.conf.telemetry_history)
+                history=self.conf.telemetry_history,
+                extra_sources=(lambda: global_registry().snapshot(),))
             self.telemetry.start()
         else:
             self.telemetry = NULL_TELEMETRY
@@ -710,6 +717,27 @@ class ShuffleManager:
                         // (1 << 20)),
                 })
             self.heartbeat.start()
+        # alerting (obs/alerts.py + obs/baseline.py): service mode the
+        # daemon owns THE evaluator (per-tenant rules need the shared
+        # usage rings); a standalone manager runs its own against its
+        # own telemetry store.
+        self.baselines = None
+        self.alerts = None
+        if (not self._service_mode and self.telemetry.enabled
+                and self.conf.alert_eval_s > 0):
+            self.baselines = (BaselineStore(self.conf.baseline_dir)
+                              if self.conf.baseline_dir else None)
+            self.alerts = AlertEvaluator(
+                telemetry=self.telemetry,
+                metrics=self.metrics,
+                journal=self.journal,
+                baselines=self.baselines,
+                heartbeat=self.heartbeat,
+                interval_s=self.conf.alert_eval_s,
+                fire_after=self.conf.alert_fire_breaches,
+                resolve_after=self.conf.alert_resolve_windows,
+                geometry=f"w{self.runtime.num_partitions}")
+            self.alerts.start()
         # probe endpoint (obs/probe.py): read-only wire snapshots for
         # shuffle_top --connect. Service mode: the daemon owns THE probe
         # (with tenant usage); standalone managers start their own.
@@ -725,7 +753,11 @@ class ShuffleManager:
                     identity=self.runtime.process_identity(),
                     journal_path=self._sink_path,
                     rollups=(self.rollup.peek
-                             if self.rollup is not None else None))
+                             if self.rollup is not None else None),
+                    alerts=(self.alerts.active
+                            if self.alerts is not None else None),
+                    health=(self.alerts.health
+                            if self.alerts is not None else None))
                 self.probe.start()
             except OSError:
                 log.warning("probe endpoint failed to bind port %d",
@@ -1001,6 +1033,9 @@ class ShuffleManager:
             self.stats.print_histogram()
         if self.heartbeat is not None:
             self.heartbeat.stop()       # emits one final beat
+        if self.alerts is not None:
+            self.alerts.stop()          # persists dirty baselines
+            self.alerts = None
         if self.probe is not None:
             self.probe.stop()
             self.probe = None

@@ -281,6 +281,28 @@ class ShuffleConf:
     #: ``shuffle_top --connect``. -1 (default) disables; 0 binds an
     #: ephemeral port (tests — read it back from ``probe.port``).
     probe_port: int = -1
+    #: alert-evaluator cadence (sparkrdma_tpu.obs.alerts): every this
+    #: many seconds a daemon thread evaluates ALERT_RULES against the
+    #: telemetry store with hysteresis, journaling {"kind":"alert"}
+    #: fire/resolve lines and serving /alerts + /health on the probe.
+    #: Requires the telemetry store (telemetry_window_s > 0). 0
+    #: (default) disables.
+    alert_eval_s: float = 0.0
+    #: alert hysteresis, fire side: a rule must breach this many
+    #: CONSECUTIVE evaluations before its alert fires (K in K-of-K) —
+    #: one noisy window never pages anyone.
+    alert_fire_breaches: int = 3
+    #: alert hysteresis, resolve side: an active alert must see this
+    #: many consecutive clean evaluations before it resolves — a
+    #: flapping signal holds one alert open instead of storming.
+    alert_resolve_windows: int = 2
+    #: persisted-baseline directory (sparkrdma_tpu.obs.baseline): the
+    #: alert evaluator's baseline-anomaly rules and bench.py's
+    #: regression gate read/update robust per-metric statistics in
+    #: ``<baseline_dir>/baselines.json`` across runs. Empty (default)
+    #: disables baselines (anomaly rules stay quiet; bench runs
+    #: ungated).
+    baseline_dir: str = ""
 
     # --- fault handling ---
     max_retry_attempts: int = 3       # maxConnectionAttempts analogue
@@ -482,6 +504,14 @@ class ShuffleConf:
         if not -1 <= self.probe_port <= 65535:
             raise ValueError("probe_port must be in [-1, 65535] "
                              "(-1 disables, 0 = ephemeral)")
+        if self.alert_eval_s < 0:
+            raise ValueError("alert_eval_s must be >= 0 (0 disables)")
+        if self.alert_fire_breaches < 1:
+            raise ValueError("alert_fire_breaches must be >= 1 "
+                             "(1 = fire on first breach)")
+        if self.alert_resolve_windows < 1:
+            raise ValueError("alert_resolve_windows must be >= 1 "
+                             "(1 = resolve on first clean window)")
         if self.spill_tier_host_bytes < 0:
             raise ValueError("spill_tier_host_bytes must be >= 0 (0 = "
                              "evict every unpinned host segment)")

@@ -470,3 +470,147 @@ def check_counter_name_sync(ctx: LintContext) -> List[Finding]:
                     "not declared in obs/names.py — it would render as "
                     "zero forever"))
     return findings
+
+
+# ---------------------------------------------------------------------
+# alert-rule-sync
+# ---------------------------------------------------------------------
+
+#: alert-line access pattern; by convention the CLIs bind an alert dict
+#: to ``al`` before reading fields from it (the rb/hb convention)
+ALERT_GET = re.compile(r'\bal\.get\(\s*"([A-Za-z0-9_]+)"')
+
+
+def _alert_rule_registrations(sf: SourceFile) -> List[tuple]:
+    """``(rule_id, lineno, [metric names])`` per ``alert_rule(...)`` /
+    ``AlertRule(...)`` registration in obs/alerts.py. Non-literal ids
+    or metrics tuples yield ``None`` entries the caller flags."""
+    regs = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id in ("alert_rule", "AlertRule")))):
+            continue
+        rid = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            rid = node.args[0].value
+        metrics: Optional[List[str]] = []
+        for kw in node.keywords:
+            if kw.arg == "id" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                rid = kw.value.value
+            if kw.arg == "metrics":
+                elts = string_elts(kw.value)
+                metrics = list(elts) if elts is not None else None
+        regs.append((rid, node.lineno, metrics))
+    return regs
+
+
+def _alert_line_keys(sf: SourceFile) -> Optional[tuple]:
+    """(keys, lineno) of the ``{"kind": "alert", ...}`` dict literal the
+    emitter builds, or None when no such literal exists."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = []
+        is_alert = False
+        literal = True
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                literal = False
+                break
+            keys.append(k.value)
+            if k.value == "kind" and isinstance(v, ast.Constant) \
+                    and v.value == "alert":
+                is_alert = True
+        if literal and is_alert:
+            return set(keys), node.lineno
+    return None
+
+
+@rule("alert-rule-sync",
+      "ALERT_RULES metrics name declared metrics, the alert-line "
+      "emitter matches ALERT_FIELDS exactly, and CLI alert-field reads "
+      "exist on the schema", kind="schema-sync")
+def check_alert_rule_sync(ctx: LintContext) -> List[Finding]:
+    """Convention the rule pins: CLIs bind an alert dict to ``al``
+    before reading fields (the span/rb/hb convention), and every rule
+    registration declares the registry metrics it consumes as a literal
+    ``metrics=(...)`` tuple."""
+    alerts_sf = ctx.file("sparkrdma_tpu/obs/alerts.py")
+    if alerts_sf is None:
+        return []
+    findings = []
+    fields = _frozen_field_set(alerts_sf, "ALERT_FIELDS")
+    if fields is None:
+        return [Finding("alert-rule-sync", alerts_sf.rel, 0,
+                        "obs/alerts.py must declare ALERT_FIELDS as a "
+                        "literal frozenset of strings",
+                        obj="sparkrdma_tpu")]
+
+    # (a) the emitter's dict literal carries exactly ALERT_FIELDS —
+    # both directions, so a key added to one side must hit the other
+    line_keys = _alert_line_keys(alerts_sf)
+    if line_keys is None:
+        findings.append(Finding(
+            "alert-rule-sync", alerts_sf.rel, 0,
+            "obs/alerts.py builds no literal {\"kind\": \"alert\"} "
+            "line dict — the emitter drifted from the lintable shape",
+            obj="sparkrdma_tpu"))
+    else:
+        keys, lineno = line_keys
+        for extra in sorted(keys - fields):
+            findings.append(Finding(
+                "alert-rule-sync", alerts_sf.rel, lineno,
+                f"the alert line emits key {extra!r} missing from "
+                "ALERT_FIELDS — declare it", obj="sparkrdma_tpu"))
+        for missing in sorted(fields - keys):
+            findings.append(Finding(
+                "alert-rule-sync", alerts_sf.rel, lineno,
+                f"ALERT_FIELDS declares {missing!r} but the alert line "
+                "never emits it — stale schema entry",
+                obj="sparkrdma_tpu"))
+
+    # (b) every rule's declared metrics exist in obs/names.py (exact
+    # name or declared wildcard pattern)
+    names_sf = ctx.file("sparkrdma_tpu/obs/names.py")
+    declared = _declared_names(names_sf) if names_sf is not None else None
+    if declared is not None:
+        all_declared = (set(declared["COUNTERS"])
+                        | set(declared["GAUGES"])
+                        | set(declared["HISTOGRAMS"]))
+        wildcards = set(declared["WILDCARDS"])
+        for rid, lineno, metrics in _alert_rule_registrations(alerts_sf):
+            label = rid if rid is not None else "<non-literal id>"
+            if metrics is None:
+                # non-literal metrics tuples (the decorator helper
+                # forwarding its parameter) can't be checked statically
+                continue
+            for m in metrics:
+                ok = m in all_declared or m in wildcards or any(
+                    fnmatch.fnmatchcase(m, w) for w in wildcards)
+                if not ok:
+                    findings.append(Finding(
+                        "alert-rule-sync", alerts_sf.rel, lineno,
+                        f"alert rule {label!r} references metric {m!r} "
+                        "which obs/names.py does not declare — the rule "
+                        "would watch a series nothing emits",
+                        obj="sparkrdma_tpu"))
+
+    # (c) every CLI read of an alert field exists on the schema
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in ALERT_GET.finditer(line):
+                if m.group(1) not in fields:
+                    findings.append(Finding(
+                        "alert-rule-sync", sf.rel, lineno,
+                        f"scripts/{script} reads alert field "
+                        f"{m.group(1)!r} which does not exist in "
+                        "obs.alerts.ALERT_FIELDS — rename the field or "
+                        "fix the script", obj="scripts"))
+    return findings

@@ -48,7 +48,10 @@ Besides spans, a journal may carry **auxiliary lines** tagged with a
   :mod:`sparkrdma_tpu.obs.rollup` (exact counts even under sampling);
 - ``{"kind": "heartbeat", ...}`` — periodic liveness lines (process
   identity, uptime, in-flight reads, pool occupancy, rss) so a silent
-  host is distinguishable from an idle one.
+  host is distinguishable from an idle one;
+- ``{"kind": "alert", ...}`` — alert lifecycle records (fired /
+  resolved) from :mod:`sparkrdma_tpu.obs.alerts`, the rule engine's
+  durable evidence trail consumed by ``shuffle_report --doctor``.
 
 :func:`read_journal` returns spans only; :func:`read_entries` returns
 everything.
@@ -113,7 +116,12 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: the span's wall-clock) and ``bottleneck`` (the derived verdict, one
 #: of obs/critical_path.py VERDICTS or "" when unattributed). PER-SPAN
 #: — obs/critical_path.py §enrich, called at both emission sites.
-SCHEMA_VERSION = 10
+#: v11: + auxiliary ``{"kind": "alert"}`` lines (obs/alerts.py
+#: ALERT_FIELDS — rule-engine fire/resolve records). Span fields are
+#: unchanged from v10, so v10↔v11 interchange is pure kind-tolerance:
+#: a v10 reader skips the unknown kind, a v11 reader reads v10 lines
+#: verbatim (pinned by tests/test_alerts.py).
+SCHEMA_VERSION = 11
 
 
 @dataclasses.dataclass

@@ -79,6 +79,8 @@ COUNTERS = frozenset({
     "probe.requests",
     "probe.errors",
     "critical_path.attributions",
+    "alerts.fired",
+    "alerts.resolved",
 })
 
 #: Point-in-time gauges (``registry.gauge(name)``).
@@ -89,6 +91,7 @@ GAUGES = frozenset({
     "store.host_bytes",
     "store.disk_bytes",
     "service.tenants",
+    "alerts.active",
 })
 
 #: Distributions (``registry.histogram(name)``).

@@ -23,6 +23,16 @@ paths:
   (open-window) rollup cells, per-tenant usage.
 - ``/metrics``  — Prometheus-style text exposition of the registry
   (dots become underscores; histograms export ``_count``/``_sum``).
+- ``/alerts``   — the alert evaluator's currently-active alerts
+  (obs/alerts.py line dicts), empty when no evaluator is wired.
+- ``/health``   — the evaluator's worst-active-severity verdict:
+  ``{"status", "score", "active", "subsystems"}`` (``status: "ok"``
+  without an evaluator — absence of alerting is not unhealth).
+
+``/snapshot``, ``/alerts`` and ``/health`` all carry ``served_at_s`` (a
+``time.monotonic()`` reading) and ``uptime_s`` (seconds since this
+server started) so wire consumers can compute staleness between polls
+of the same daemon without trusting either side's wall clock.
 
 Isolation contract: probe serving never touches shuffle state — every
 route reads an immutable snapshot (journal file, registry snapshot,
@@ -40,6 +50,7 @@ import json
 import logging
 import socket
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("sparkrdma_tpu.probe")
@@ -92,13 +103,18 @@ class ProbeServer:
                  identity: Optional[Dict] = None,
                  journal_path: str = "",
                  rollups: Optional[Callable[[], List[Dict]]] = None,
-                 tenants: Optional[Callable[[], Dict]] = None):
+                 tenants: Optional[Callable[[], Dict]] = None,
+                 alerts: Optional[Callable[[], List[Dict]]] = None,
+                 health: Optional[Callable[[], Dict]] = None):
         self._metrics = metrics
         self._telemetry = telemetry
         self._identity = dict(identity or {})
         self._journal_path = journal_path
         self._rollups = rollups
         self._tenants = tenants
+        self._alerts = alerts
+        self._health = health
+        self._started_mono = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -182,9 +198,18 @@ class ProbeServer:
             return _prometheus_text(snap)
         if path == "/snapshot":
             return json.dumps(self._snapshot())
+        if path == "/alerts":
+            alerts = self._alerts() if self._alerts is not None else []
+            return json.dumps(dict(self._staleness(), alerts=alerts))
+        if path == "/health":
+            health = (self._health() if self._health is not None
+                      else {"status": "ok", "score": 100, "active": 0,
+                            "subsystems": {}})
+            return json.dumps(dict(self._staleness(), **health))
         return json.dumps({"error": f"unknown path {path!r}",
                            "paths": ["/journal", "/snapshot",
-                                     "/metrics"]})
+                                     "/metrics", "/alerts",
+                                     "/health"]})
 
     def _journal_entries(self) -> List[Dict]:
         if not self._journal_path:
@@ -199,17 +224,29 @@ class ProbeServer:
             # an empty process legitimately serves an empty array
             return []
 
+    def _staleness(self) -> Dict:
+        """Monotonic serving-time stamps — lets a wire consumer compute
+        poll-to-poll staleness of ONE daemon without trusting wall
+        clocks (monotonic readings are only comparable within a single
+        server process; ``uptime_s`` restarting at 0 is the restart
+        signal)."""
+        now = time.monotonic()
+        return {
+            "served_at_s": round(now, 6),
+            "uptime_s": round(now - self._started_mono, 6),
+        }
+
     def _snapshot(self) -> Dict:
         telemetry = (self._telemetry.stats()
                      if self._telemetry is not None else {})
         rollups = self._rollups() if self._rollups is not None else []
         tenants = self._tenants() if self._tenants is not None else {}
-        return {
+        return dict(self._staleness(), **{
             "identity": self._identity,
             "telemetry": telemetry,
             "rollups": rollups,
             "tenants": tenants,
-        }
+        })
 
 
 __all__ = ["ProbeServer"]

@@ -369,6 +369,7 @@ class HeartbeatEmitter:
         self._lock = threading.Lock()
         self.seq = 0                                 # guarded-by: _lock
         self.beat_errors = 0                         # guarded-by: _lock
+        self._last_beat_at = clock()                 # guarded-by: _lock
 
     def start(self) -> None:
         if self._thread is not None or self.interval_s <= 0:
@@ -407,6 +408,7 @@ class HeartbeatEmitter:
             with self._lock:
                 self.seq += 1
                 seq = self.seq
+                self._last_beat_at = now
             d = {
                 "kind": "heartbeat",
                 "schema": SCHEMA_VERSION,
@@ -442,6 +444,13 @@ class HeartbeatEmitter:
                 first = self.beat_errors == 1
             if first:
                 log.exception("heartbeat emission failed")
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last successful-or-attempted beat — the
+        alert engine's heartbeat-staleness signal."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return max(0.0, now - self._last_beat_at)
 
     def stop(self, final_beat: bool = True) -> None:
         self._stop.set()

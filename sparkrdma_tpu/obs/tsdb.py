@@ -43,13 +43,32 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 log = logging.getLogger("sparkrdma_tpu.tsdb")
 
 #: default ring capacity (samples retained per series and rollup
 #: windows retained per shuffle) — ShuffleConf.telemetry_history
 DEFAULT_HISTORY = 120
+
+
+class Windowed(NamedTuple):
+    """A windowed query answer that is honest about its window.
+
+    Ring eviction (or a young process) can leave fewer trailing seconds
+    in the ring than the caller asked for — a ``delta`` over a
+    requested 30s window silently computed from 4s of data would
+    overstate calm and understate storms. ``effective_s`` is the actual
+    elapsed time between the two endpoints used, so consumers (alert
+    rules, the probe) can scale or discard short answers.
+    """
+
+    value: float
+    effective_s: float
+
+
+#: shared zero answer for the empty/disabled paths (allocation-free)
+ZERO_WINDOWED = Windowed(0.0, 0.0)
 
 #: shared immutable empties for the disabled path (allocation-free)
 _EMPTY_TUPLE: tuple = ()
@@ -63,13 +82,20 @@ class TelemetryStore:
 
     def __init__(self, registry, window_s: float = 1.0,
                  history: int = DEFAULT_HISTORY,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 extra_sources: Tuple[Callable[[], Dict], ...] = ()):
         if window_s < 0:
             raise ValueError("telemetry window_s must be >= 0")
         if history < 2:
             raise ValueError("telemetry history must be >= 2 "
                              "(rate/delta need two samples)")
         self._registry = registry
+        # additional snapshot callables folded into every sample —
+        # the manager passes the process-global registry here so
+        # globally-recorded series (store.*, staging.*, degrade.*)
+        # are queryable next to the manager's own; the primary
+        # registry wins on name collisions
+        self._extra_sources = tuple(extra_sources)
         self.window_s = float(window_s)
         self.history = int(history)
         self._clock = clock
@@ -108,6 +134,10 @@ class TelemetryStore:
             snap = self._registry.snapshot()
             flat = {k: v for k, v in snap.items()
                     if isinstance(v, (int, float))}
+            for src in self._extra_sources:
+                for k, v in src().items():
+                    if isinstance(v, (int, float)):
+                        flat.setdefault(k, v)
             with self._lock:
                 if len(self._samples) == self._samples.maxlen:
                     self.evicted += 1
@@ -167,26 +197,32 @@ class TelemetryStore:
         with self._lock:
             return self._points(name, span_s)
 
-    def delta(self, name: str, span_s: Optional[float] = None) -> float:
-        """newest − oldest value over the window (0.0 with < 2 points).
-        Exact for counters: both endpoints are true registry values."""
+    def delta(self, name: str, span_s: Optional[float] = None
+              ) -> Windowed:
+        """newest − oldest value over the window, with the *effective*
+        elapsed seconds between those endpoints (zero with < 2 points).
+        Exact for counters: both endpoints are true registry values.
+        When eviction (or a young ring) holds less history than
+        ``span_s`` asked for, ``effective_s`` says so."""
         with self._lock:
             pts = self._points(name, span_s)
         if len(pts) < 2:
-            return 0.0
-        return pts[-1][1] - pts[0][1]
+            return ZERO_WINDOWED
+        return Windowed(pts[-1][1] - pts[0][1], pts[-1][0] - pts[0][0])
 
-    def rate(self, name: str, span_s: Optional[float] = None) -> float:
-        """Per-second rate of change over the window (0.0 with < 2
+    def rate(self, name: str, span_s: Optional[float] = None
+             ) -> Windowed:
+        """Per-second rate of change over the window, with the
+        effective elapsed seconds it was computed over (zero with < 2
         points or zero elapsed time between them)."""
         with self._lock:
             pts = self._points(name, span_s)
         if len(pts) < 2:
-            return 0.0
+            return ZERO_WINDOWED
         elapsed = pts[-1][0] - pts[0][0]
         if elapsed <= 0:
-            return 0.0
-        return (pts[-1][1] - pts[0][1]) / elapsed
+            return ZERO_WINDOWED
+        return Windowed((pts[-1][1] - pts[0][1]) / elapsed, elapsed)
 
     def rollup_history(self, shuffle_id: int, tenant: str = ""
                        ) -> List[Dict]:
@@ -256,11 +292,13 @@ class _NullTelemetryStore(TelemetryStore):
     def window(self, name: str, span_s: Optional[float] = None):
         return _EMPTY_TUPLE
 
-    def delta(self, name: str, span_s: Optional[float] = None) -> float:
-        return 0.0
+    def delta(self, name: str, span_s: Optional[float] = None
+              ) -> Windowed:
+        return ZERO_WINDOWED
 
-    def rate(self, name: str, span_s: Optional[float] = None) -> float:
-        return 0.0
+    def rate(self, name: str, span_s: Optional[float] = None
+             ) -> Windowed:
+        return ZERO_WINDOWED
 
     def rollup_history(self, shuffle_id: int, tenant: str = ""):
         return _EMPTY_TUPLE
@@ -284,4 +322,5 @@ class _NullRegistry:
 NULL_TELEMETRY = _NullTelemetryStore()
 
 
-__all__ = ["TelemetryStore", "NULL_TELEMETRY", "DEFAULT_HISTORY"]
+__all__ = ["TelemetryStore", "NULL_TELEMETRY", "DEFAULT_HISTORY",
+           "Windowed", "ZERO_WINDOWED"]

@@ -39,8 +39,10 @@ from typing import Dict, List, Optional
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.config import ShuffleConf
 from sparkrdma_tpu.hbm.tiered_store import TieredStore
+from sparkrdma_tpu.obs.alerts import AlertEvaluator
+from sparkrdma_tpu.obs.baseline import BaselineStore
 from sparkrdma_tpu.obs.journal import ExchangeJournal
-from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.metrics import MetricsRegistry, global_registry
 from sparkrdma_tpu.obs.probe import ProbeServer
 from sparkrdma_tpu.obs.rollup import HeartbeatEmitter
 from sparkrdma_tpu.obs.tsdb import NULL_TELEMETRY, TelemetryStore
@@ -114,12 +116,36 @@ class ShuffleService:
         # per session, so the probe's live-rollup view sums session
         # peeks on demand.
         if self.metrics.enabled and self.conf.telemetry_window_s > 0:
+            # fold the process-global registry in (store.*, staging.*,
+            # degrade.* live there) so alert rules can watch them here
             self.telemetry = TelemetryStore(
                 self.metrics, window_s=self.conf.telemetry_window_s,
-                history=self.conf.telemetry_history)
+                history=self.conf.telemetry_history,
+                extra_sources=(lambda: global_registry().snapshot(),))
             self.telemetry.start()
         else:
             self.telemetry = NULL_TELEMETRY
+        # persisted baselines + the alert evaluator: the daemon owns
+        # THE rule engine (per-tenant rules read the shared usage
+        # rings); sessions never start their own. Baselines are keyed
+        # by mesh geometry so a topology change never reads as an
+        # anomaly.
+        self.baselines = (BaselineStore(self.conf.baseline_dir)
+                          if self.conf.baseline_dir else None)
+        self.alerts = None
+        if self.telemetry.enabled and self.conf.alert_eval_s > 0:
+            self.alerts = AlertEvaluator(
+                telemetry=self.telemetry,
+                metrics=self.metrics,
+                journal=self.journal,
+                baselines=self.baselines,
+                heartbeat=self.heartbeat,
+                tenants=self.tenants.usage_by_tenant,
+                interval_s=self.conf.alert_eval_s,
+                fire_after=self.conf.alert_fire_breaches,
+                resolve_after=self.conf.alert_resolve_windows,
+                geometry=f"w{self.runtime.num_partitions}")
+            self.alerts.start()
         self.probe = None
         if self.conf.probe_port >= 0:
             try:
@@ -130,7 +156,11 @@ class ShuffleService:
                     identity=self.runtime.process_identity(),
                     journal_path=self._sink_path,
                     rollups=self._live_rollups,
-                    tenants=self.tenants.usage_by_tenant)
+                    tenants=self.tenants.usage_by_tenant,
+                    alerts=(self.alerts.active
+                            if self.alerts is not None else None),
+                    health=(self.alerts.health
+                            if self.alerts is not None else None))
                 self.probe.start()
             except OSError:
                 # the probe must never take the daemon down with it
@@ -245,6 +275,9 @@ class ShuffleService:
             m.stop()
         if self.heartbeat is not None:
             self.heartbeat.stop()       # emits one final beat
+        if self.alerts is not None:
+            self.alerts.stop()          # persists dirty baselines
+            self.alerts = None
         if self.probe is not None:
             self.probe.stop()
             self.probe = None

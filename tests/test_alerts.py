@@ -1,0 +1,497 @@
+"""Alerting engine (obs/alerts.py) + persisted baselines
+(obs/baseline.py):
+
+- hysteresis: K consecutive breaches fire, M consecutive clean windows
+  resolve — a flapping signal produces one alert, not a storm;
+- dedup keys: per-tenant / per-shuffle breaches of one rule track
+  independent lifecycle state;
+- the journaled ``{"kind": "alert"}`` line: exact :data:`ALERT_FIELDS`
+  key set (v11), and the v10 <-> v11 interchange contract — an alert
+  line is a *new kind*, so span readers on either side ignore it rather
+  than choke;
+- the built-in rules against synthetic telemetry: a chaos-shaped store
+  fires spill/straggler/quota rules while a clean control store fires
+  none;
+- never-raises: a crashing rule is counted and skipped, the rest run;
+- BaselineStore: EWMA median/MAD statistics, robust z-scores,
+  atomic persistence, corrupt-file tolerance, schema versioning;
+- evaluator lifecycle: the cadence thread starts/joins cleanly and
+  dirty baselines are persisted on stop.
+"""
+
+import json
+import threading
+
+import pytest
+
+from sparkrdma_tpu.obs import alerts as A
+from sparkrdma_tpu.obs.alerts import (ALERT_FIELDS, ALERT_RULES,
+                                      AlertEvaluator, AlertRule, Breach)
+from sparkrdma_tpu.obs.baseline import (BASELINE_SCHEMA, BaselineStore)
+from sparkrdma_tpu.obs.journal import SCHEMA_VERSION, ExchangeSpan
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.names import COUNTERS, GAUGES, WILDCARDS
+from sparkrdma_tpu.obs.tsdb import TelemetryStore
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> float:
+        self.t += dt
+        return self.t
+
+
+class ListJournal:
+    """Collects emit_raw lines like the real journal would."""
+
+    def __init__(self):
+        self.lines = []
+
+    def emit_raw(self, d):
+        self.lines.append(dict(d))
+
+
+def flag_rule(rid="test_rule", severity="warn", breaches=lambda ctx: []):
+    return AlertRule(id=rid, severity=severity, subsystem="test",
+                     condition="derived", metrics=(), description="",
+                     check=breaches)
+
+
+def make_eval(rules, fire_after=3, resolve_after=2, **kw):
+    reg = MetricsRegistry()
+    store = TelemetryStore(reg, window_s=0.0, history=8,
+                           clock=FakeClock())
+    journal = ListJournal()
+    ev = AlertEvaluator(telemetry=store, metrics=reg, journal=journal,
+                        rules={r.id: r for r in rules},
+                        interval_s=0.0, fire_after=fire_after,
+                        resolve_after=resolve_after,
+                        clock=FakeClock(), **kw)
+    return reg, journal, ev
+
+
+class TestHysteresis:
+    def test_fires_only_after_k_consecutive_breaches(self):
+        on = [True]
+        rule = flag_rule(breaches=lambda ctx: (
+            [Breach(value=1.0, message="hot")] if on[0] else []))
+        reg, journal, ev = make_eval([rule], fire_after=3)
+        assert ev.evaluate_once() == []          # breach 1
+        assert ev.evaluate_once() == []          # breach 2
+        fired = ev.evaluate_once()               # breach 3: fires
+        assert [d["event"] for d in fired] == ["fired"]
+        assert fired[0]["rule"] == "test_rule"
+        assert fired[0]["breaches"] == 3
+        assert ev.evaluate_once() == []          # already active: silent
+        assert reg.counter("alerts.fired").value == 1
+        assert reg.gauge("alerts.active").value == 1
+
+    def test_resolves_only_after_m_clean_windows(self):
+        on = [True]
+        rule = flag_rule(breaches=lambda ctx: (
+            [Breach(value=1.0)] if on[0] else []))
+        reg, journal, ev = make_eval([rule], fire_after=1,
+                                     resolve_after=2)
+        assert ev.evaluate_once()[0]["event"] == "fired"
+        on[0] = False
+        assert ev.evaluate_once() == []          # clean 1: still active
+        resolved = ev.evaluate_once()            # clean 2: resolves
+        assert [d["event"] for d in resolved] == ["resolved"]
+        assert reg.counter("alerts.resolved").value == 1
+        assert reg.gauge("alerts.active").value == 0
+        assert ev.active() == []
+
+    def test_flapping_produces_one_alert_not_a_storm(self):
+        """on-off-on-off... with fire_after=2 never fires; with
+        fire_after=1 / resolve_after=2 it fires ONCE and stays active
+        through the flaps (re-breach refreshes silently)."""
+        step = [0]
+        rule = flag_rule(breaches=lambda ctx: (
+            [Breach(value=1.0)] if step[0] % 2 == 0 else []))
+        _, journal, ev = make_eval([rule], fire_after=2, resolve_after=2)
+        for _ in range(8):
+            ev.evaluate_once()
+            step[0] += 1
+        assert journal.lines == [], \
+            "alternating breaches must never reach fire_after=2"
+
+        step = [0]
+        rule = flag_rule(breaches=lambda ctx: (
+            [Breach(value=1.0)] if step[0] % 2 == 0 else []))
+        _, journal, ev = make_eval([rule], fire_after=1, resolve_after=2)
+        for _ in range(8):
+            ev.evaluate_once()
+            step[0] += 1
+        assert [d["event"] for d in journal.lines] == ["fired"], \
+            "flapping under resolve_after=2 is ONE alert, no storm"
+
+    def test_dedup_keys_track_independent_state(self):
+        """Two tenants breaching one rule are separate alerts; one
+        tenant going clean resolves only its own."""
+        tenants = {"a": True, "b": True}
+        rule = flag_rule(breaches=lambda ctx: [
+            Breach(dedup=t, tenant=t, value=1.0)
+            for t, hot in sorted(tenants.items()) if hot])
+        reg, journal, ev = make_eval([rule], fire_after=1,
+                                     resolve_after=1)
+        fired = ev.evaluate_once()
+        assert sorted(d["dedup"] for d in fired) == ["a", "b"]
+        tenants["a"] = False
+        lines = ev.evaluate_once()
+        assert [(d["event"], d["dedup"]) for d in lines] == \
+            [("resolved", "a")]
+        assert [d["dedup"] for d in ev.active()] == ["b"]
+        assert reg.gauge("alerts.active").value == 1
+
+
+class TestAlertLine:
+    def test_line_carries_exactly_alert_fields(self):
+        rule = flag_rule(breaches=lambda ctx: [
+            Breach(dedup="t0", tenant="t0", value=2.5, threshold=1.0,
+                   message="spilling")])
+        _, journal, ev = make_eval([rule], fire_after=1)
+        (line,) = ev.evaluate_once()
+        assert set(line) == ALERT_FIELDS
+        assert line["kind"] == "alert"
+        assert line["schema"] == SCHEMA_VERSION
+        assert line["severity"] == "warn"
+        assert line["value"] == 2.5 and line["threshold"] == 1.0
+        assert journal.lines == [line]
+
+    def test_schema_is_v11(self):
+        assert SCHEMA_VERSION == 11
+
+    def test_v10_reader_interchange(self):
+        """An alert line is a new KIND, not new span fields: a v10-era
+        span consumer filtering on kind=="span"/absence of kind skips
+        it, and a v11 span parses under the v10 field set untouched.
+        This is the v10 <-> v11 interchange pin."""
+        rule = flag_rule(breaches=lambda ctx: [Breach(value=1.0)])
+        _, journal, ev = make_eval([rule], fire_after=1)
+        (alert_line,) = ev.evaluate_once()
+        # a v10 reader's kind-dispatch never routes an alert line into
+        # span decoding (kind is explicit, unlike bare span lines)
+        assert alert_line["kind"] not in ("span", "rollup", "heartbeat")
+        # and the alert carries no span-payload keys a v10 span reader
+        # would mis-fold into exchange statistics
+        span_only = {"span_id", "exchange_s", "records", "rounds"}
+        assert not (set(alert_line) & span_only)
+        # a v11 span round-trips bit-identically (alerting added no
+        # span fields — the kind is the whole delta)
+        span = ExchangeSpan(span_id=1, shuffle_id=2, transport="emu",
+                            rounds=1, dispatches=1, records=10,
+                            record_bytes=16, plan_s=0.0, exchange_s=0.1,
+                            sort_s=0.0, per_peer_records=[10])
+        d = span.to_dict()
+        assert d["schema"] == 11
+        assert ExchangeSpan.from_dict(d) == span
+
+    def test_active_lines_are_valid_alert_lines(self):
+        rule = flag_rule(breaches=lambda ctx: [Breach(value=3.0)])
+        _, _, ev = make_eval([rule], fire_after=1)
+        ev.evaluate_once()
+        (live,) = ev.active()
+        assert set(live) == ALERT_FIELDS
+        assert live["event"] == "fired"
+
+
+class TestHealth:
+    def test_health_penalties_and_worst_severity(self):
+        rules = [
+            flag_rule("warn_rule", "warn",
+                      lambda ctx: [Breach(value=1.0)]),
+            flag_rule("crit_rule", "crit",
+                      lambda ctx: [Breach(value=9.0)]),
+        ]
+        _, _, ev = make_eval(rules, fire_after=1)
+        h0 = ev.health()
+        assert h0 == {"status": "ok", "score": 100, "active": 0,
+                      "subsystems": {"test": "ok"}}
+        ev.evaluate_once()
+        h = ev.health()
+        assert h["status"] == "crit"
+        assert h["score"] == 100 - 25 - 60
+        assert h["active"] == 2
+        assert h["subsystems"]["test"] == "crit"
+
+    def test_stats_shape(self):
+        rule = flag_rule(breaches=lambda ctx: [Breach(value=1.0)])
+        _, _, ev = make_eval([rule], fire_after=1)
+        ev.evaluate_once()
+        s = ev.stats()
+        assert s == {"rules": 1, "evals": 1, "eval_errors": 0,
+                     "active": 1}
+
+
+class TestNeverRaises:
+    def test_crashing_rule_is_counted_and_skipped(self):
+        def boom(ctx):
+            raise RuntimeError("rule bug")
+
+        rules = [flag_rule("bad", "warn", boom),
+                 flag_rule("good", "warn",
+                           lambda ctx: [Breach(value=1.0)])]
+        _, _, ev = make_eval(rules, fire_after=1)
+        (line,) = ev.evaluate_once()
+        assert line["rule"] == "good", "the healthy rule still runs"
+        assert ev.stats()["eval_errors"] == 1
+
+    def test_evaluate_once_never_raises(self):
+        class PoisonTelemetry:
+            enabled = True
+
+            def stats(self):
+                raise RuntimeError("boom")
+
+        ev = AlertEvaluator(telemetry=PoisonTelemetry(),
+                            metrics=MetricsRegistry(), interval_s=0.0,
+                            clock=FakeClock())
+        assert ev.evaluate_once() == []
+        assert ev.stats()["eval_errors"] == 1
+
+
+class TestBuiltinRules:
+    """The shipped registry against synthetic telemetry: a chaos-shaped
+    store trips spill/straggler/quota, a clean store trips nothing."""
+
+    def _evaluator(self, chaos: bool):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=16, clock=clk)
+        spill = reg.counter("store.spill_bytes")
+        byts = reg.counter("shuffle.bytes")
+        store.sample()
+        clk.tick(1.0)
+        byts.inc(1000)
+        if chaos:
+            spill.inc(1 << 20)
+        store.sample()
+        if chaos:
+            # one slow read among ten fast ones, inside the window
+            store.observe_rollup({
+                "tenant": "t0", "shuffle_id": 7, "reads": 11,
+                "p50_ms": 4.0, "lat_max_ms": 400.0,
+                "lat_sum_ms": 440.0, "ts": clk.t})
+        else:
+            store.observe_rollup({
+                "tenant": "t0", "shuffle_id": 7, "reads": 11,
+                "p50_ms": 4.0, "lat_max_ms": 5.0,
+                "lat_sum_ms": 45.0, "ts": clk.t})
+        usage = {"t0": {"quota_waits": 4 if chaos else 0}}
+        ev = AlertEvaluator(telemetry=store, metrics=reg,
+                            journal=ListJournal(),
+                            tenants=lambda: dict(usage),
+                            interval_s=0.0, fire_after=1,
+                            resolve_after=2, clock=clk)
+        return ev
+
+    def test_chaos_store_fires_spill_straggler_quota(self):
+        ev = self._evaluator(chaos=True)
+        ev.evaluate_once()                     # prev usage snapshot = {}
+        fired = {d["rule"] for d in ev.active()}
+        assert "spill_storm" in fired
+        assert "straggler_spread" in fired
+        # quota pileup needs growth BETWEEN evaluations — seen on eval 1
+        # because prev was empty... assert its dedup carries the tenant
+        quota = [d for d in ev.active()
+                 if d["rule"] == "tenant_quota_pileup"]
+        assert quota and quota[0]["tenant"] == "t0"
+
+    def test_clean_store_fires_nothing(self):
+        ev = self._evaluator(chaos=False)
+        assert ev.evaluate_once() == []
+        assert ev.evaluate_once() == []
+        assert ev.active() == []
+        assert ev.health()["status"] == "ok"
+
+    def test_straggler_ignores_short_windows(self):
+        """reads < 4 (warm-up, single probes) can never breach."""
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=8, clock=clk)
+        store.observe_rollup({"tenant": "", "shuffle_id": 1, "reads": 3,
+                              "p50_ms": 1.0, "lat_max_ms": 900.0,
+                              "lat_sum_ms": 902.0, "ts": clk.t})
+        ev = AlertEvaluator(telemetry=store, metrics=reg,
+                            interval_s=0.0, fire_after=1, clock=clk)
+        assert ev.evaluate_once() == []
+
+    def test_registry_metrics_are_declared(self):
+        """Every rule's metrics tuple resolves against the names
+        registry (the runtime mirror of the alert-rule-sync lint)."""
+        import fnmatch
+        declared = set(COUNTERS) | set(GAUGES)
+        for rule in ALERT_RULES.values():
+            for m in rule.metrics:
+                ok = (m in declared or m in WILDCARDS or
+                      any(fnmatch.fnmatchcase(m, w) for w in WILDCARDS))
+                assert ok, f"rule {rule.id}: undeclared metric {m}"
+
+    def test_duplicate_rule_id_rejected(self):
+        with pytest.raises(ValueError):
+            A.register_rule(flag_rule("spill_storm"))
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            flag_rule(severity="fatal")
+        with pytest.raises(ValueError):
+            AlertRule(id="x", severity="warn", subsystem="s",
+                      condition="psychic", metrics=(), description="",
+                      check=lambda ctx: [])
+
+
+class TestBaselineStore:
+    def test_observe_seeds_then_ewma(self, tmp_path):
+        bs = BaselineStore(str(tmp_path), alpha=0.5)
+        ent = bs.observe("shuffle.bytes", 100.0)
+        assert ent == {"median": 100.0, "mad": 0.0, "count": 1}
+        ent = bs.observe("shuffle.bytes", 200.0)
+        assert ent["median"] == 150.0            # 100 + .5*(200-100)
+        assert ent["mad"] == 50.0                # 0 + .5*(|200-100|-0)
+        assert ent["count"] == 2
+
+    def test_geometry_keys_are_independent(self, tmp_path):
+        bs = BaselineStore(str(tmp_path))
+        bs.observe("shuffle.bytes", 100.0, geometry="w8")
+        bs.observe("shuffle.bytes", 900.0, geometry="w32")
+        assert bs.get("shuffle.bytes", geometry="w8")["median"] == 100.0
+        assert bs.get("shuffle.bytes", geometry="w32")["median"] == 900.0
+        assert bs.get("shuffle.bytes") is None
+
+    def test_zscore_semantics(self, tmp_path):
+        bs = BaselineStore(str(tmp_path), alpha=0.5)
+        assert bs.zscore("m", 5.0) is None       # no baseline
+        bs.observe("m", 100.0)
+        assert bs.zscore("m", 5.0) is None       # count < 2
+        bs.observe("m", 120.0)
+        z_low = bs.zscore("m", 50.0)
+        z_mid = bs.zscore("m", 110.0)
+        assert z_low < z_mid
+        assert abs(z_mid) < 1.0, "the EWMA midpoint is unsurprising"
+        # degenerate flat history: finite, not a ZeroDivisionError
+        bs.observe("flat", 10.0)
+        bs.observe("flat", 10.0)
+        z = bs.zscore("flat", 20.0)
+        assert z is not None and z > 0
+
+    def test_persistence_round_trip(self, tmp_path):
+        bs = BaselineStore(str(tmp_path))
+        bs.observe("shuffle.bytes", 100.0, geometry="w8")
+        assert bs.dirty
+        assert bs.save()
+        assert not bs.dirty
+        doc = json.loads((tmp_path / "baselines.json").read_text())
+        assert doc["schema"] == BASELINE_SCHEMA
+        back = BaselineStore(str(tmp_path))
+        assert back.get("shuffle.bytes", geometry="w8")["median"] == 100.0
+        assert back.load_errors == 0
+
+    def test_corrupt_file_starts_fresh(self, tmp_path):
+        (tmp_path / "baselines.json").write_text("{not json")
+        bs = BaselineStore(str(tmp_path))
+        assert bs.load_errors == 1
+        assert bs.get("anything") is None
+        bs.observe("m", 1.0)
+        assert bs.save(), "a corrupt file must not block re-saving"
+
+    def test_newer_schema_is_ignored_not_mutated(self, tmp_path):
+        (tmp_path / "baselines.json").write_text(json.dumps(
+            {"schema": BASELINE_SCHEMA + 1, "entries": {
+                "m": {"median": 1, "mad": 0, "count": 9}}}))
+        bs = BaselineStore(str(tmp_path))
+        assert bs.load_errors == 1
+        assert bs.get("m") is None
+
+    def test_bad_entry_is_skipped_not_fatal(self, tmp_path):
+        (tmp_path / "baselines.json").write_text(json.dumps(
+            {"schema": BASELINE_SCHEMA, "entries": {
+                "good": {"median": 5.0, "mad": 1.0, "count": 3},
+                "bad": {"median": "NaN-ish"}}}))
+        bs = BaselineStore(str(tmp_path))
+        assert bs.get("good")["count"] == 3
+        assert bs.get("bad") is None
+        assert bs.load_errors == 1
+
+    def test_atomic_save_leaves_no_temp_files(self, tmp_path):
+        bs = BaselineStore(str(tmp_path))
+        bs.observe("m", 1.0)
+        assert bs.save()
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert names == ["baselines.json"]
+
+    def test_update_from_telemetry_folds_rates(self, tmp_path):
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=8, clock=clk)
+        reg.counter("shuffle.bytes").inc(0)
+        store.sample()
+        clk.tick(2.0)
+        reg.counter("shuffle.bytes").inc(1000)
+        store.sample()
+        bs = BaselineStore(str(tmp_path))
+        n = bs.update_from_telemetry(store, geometry="w8")
+        assert n >= 1
+        ent = bs.get("shuffle.bytes", geometry="w8")
+        assert ent["median"] == 500.0            # 1000 over 2s
+        assert bs.stats()["entries"] == n
+
+    def test_alpha_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            BaselineStore(str(tmp_path), alpha=0.0)
+        with pytest.raises(ValueError):
+            BaselineStore(str(tmp_path), alpha=1.5)
+
+
+class TestLifecycle:
+    def test_validation(self):
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=2)
+        with pytest.raises(ValueError):
+            AlertEvaluator(telemetry=store, metrics=reg, interval_s=-1)
+        with pytest.raises(ValueError):
+            AlertEvaluator(telemetry=store, metrics=reg, fire_after=0)
+        with pytest.raises(ValueError):
+            AlertEvaluator(telemetry=store, metrics=reg,
+                           resolve_after=0)
+
+    def test_zero_interval_never_starts_thread(self):
+        _, _, ev = make_eval([flag_rule()])
+        ev.start()
+        assert ev._thread is None
+        ev.stop()
+
+    def test_cadence_thread_evaluates_and_joins(self):
+        import time
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=8)
+        ev = AlertEvaluator(telemetry=store, metrics=reg,
+                            rules={}, interval_s=0.005)
+        before = threading.active_count()
+        ev.start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and ev.stats()["evals"] == 0:
+                time.sleep(0.005)
+            assert ev.stats()["evals"] > 0
+        finally:
+            ev.stop()
+        assert ev._thread is None
+        assert threading.active_count() <= before
+
+    def test_stop_persists_dirty_baselines(self, tmp_path):
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=8,
+                               clock=FakeClock())
+        bs = BaselineStore(str(tmp_path))
+        bs.observe("m", 5.0)
+        assert bs.dirty
+        ev = AlertEvaluator(telemetry=store, metrics=reg, baselines=bs,
+                            rules={}, interval_s=0.0, clock=FakeClock())
+        ev.stop()
+        assert not bs.dirty
+        assert BaselineStore(str(tmp_path)).get("m")["median"] == 5.0

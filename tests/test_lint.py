@@ -250,6 +250,88 @@ def test_counter_name_sync_fstring_wildcard_and_cli(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# alert-rule-sync
+# ---------------------------------------------------------------------
+
+_ALERTS = """
+    ALERT_FIELDS = frozenset({"kind", "schema", "ts", "rule"})
+
+    def _line(rule, ts):
+        return {"kind": "alert", "schema": 11, "ts": ts, "rule": rule}
+
+    def _register():
+        alert_rule("spill_storm", severity="warn", subsystem="store",
+                   condition="delta", metrics=("pool.hits", "w.spill"))
+"""
+
+
+def test_alert_rule_sync_clean(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/alerts.py": _ALERTS,
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+        "scripts/shuffle_top.py": """
+            def row(al):
+                return (al.get("rule"), al.get("ts"))
+        """,
+    })
+    assert run_rules(root, select=["alert-rule-sync"]) == []
+
+
+def test_alert_rule_sync_undeclared_metric(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/alerts.py": _ALERTS.replace(
+            '"pool.hits"', '"rogue.series"'),
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+    })
+    got = run_rules(root, select=["alert-rule-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 1 and "rogue.series" in msgs
+    assert "'spill_storm'" in msgs
+
+
+def test_alert_rule_sync_emitter_field_drift_both_ways(tmp_path):
+    # the line dict emits a key ALERT_FIELDS misses AND the schema
+    # declares a key the line never carries — both directions fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/alerts.py": _ALERTS.replace(
+            '"ts": ts,', '"when": ts,'),
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+    })
+    got = run_rules(root, select=["alert-rule-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2
+    assert "'when'" in msgs and "'ts'" in msgs
+
+
+def test_alert_rule_sync_cli_ghost_field(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/alerts.py": _ALERTS,
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+        "scripts/shuffle_report.py": """
+            def row(al):
+                return al.get("ghost_severity")
+        """,
+    })
+    got = run_rules(root, select=["alert-rule-sync"])
+    assert rules_of(got) == ["alert-rule-sync"]
+    assert "ghost_severity" in got[0].message
+    assert got[0].obj == "scripts"
+
+
+def test_alert_rule_sync_nonliteral_metrics_skipped(tmp_path):
+    # the decorator helper forwards metrics=tuple(metrics) — a
+    # non-literal tuple can't be checked statically and must not fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/alerts.py": _ALERTS + """
+    def helper(metrics):
+        alert_rule("derived_rule", metrics=tuple(metrics))
+""",
+        "sparkrdma_tpu/obs/names.py": _NAMES,
+    })
+    assert run_rules(root, select=["alert-rule-sync"]) == []
+
+
+# ---------------------------------------------------------------------
 # timeline pairing
 # ---------------------------------------------------------------------
 
@@ -1343,7 +1425,7 @@ def test_real_repo_is_srlint_clean():
     every rule, zero findings (modulo in-source suppressions) — and the
     full run must fit the tier-1 preamble's wall-clock budget."""
     from sparkrdma_tpu.lint import all_rules
-    assert len(all_rules()) == 19, \
+    assert len(all_rules()) == 20, \
         "rule count drifted — update this pin, the README table, and " \
         "COVERAGE.md together"
     t0 = time.perf_counter()

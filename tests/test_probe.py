@@ -66,7 +66,11 @@ def make_server(tmp_path, journal_lines=()):
         identity={"process_index": 0, "host": "testhost"},
         journal_path=path,
         rollups=lambda: [{"tenant": "a", "shuffle_id": 1, "reads": 2}],
-        tenants=lambda: {"a": {"hbm": 1}})
+        tenants=lambda: {"a": {"hbm": 1}},
+        alerts=lambda: [{"kind": "alert", "rule": "spill_storm",
+                         "severity": "warn", "event": "fired"}],
+        health=lambda: {"status": "warn", "score": 75, "active": 1,
+                        "subsystems": {"store": "warn"}})
     return reg, srv
 
 
@@ -81,6 +85,51 @@ class TestRoutes:
         assert snap["rollups"] == [{"tenant": "a", "shuffle_id": 1,
                                     "reads": 2}]
         assert snap["tenants"] == {"a": {"hbm": 1}}
+        # staleness stamps: monotonic serving time + server uptime
+        assert snap["served_at_s"] > 0
+        assert snap["uptime_s"] >= 0
+
+    def test_alerts_route_serves_active_alerts(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            got = json.loads(fetch(srv.port, "GET /alerts\n"))
+        assert got["alerts"][0]["rule"] == "spill_storm"
+        assert got["served_at_s"] > 0 and got["uptime_s"] >= 0
+
+    def test_health_route_serves_verdict(self, tmp_path):
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            got = json.loads(fetch(srv.port, "GET /health\n"))
+        assert got["status"] == "warn" and got["score"] == 75
+        assert got["subsystems"] == {"store": "warn"}
+        assert got["served_at_s"] > 0 and got["uptime_s"] >= 0
+
+    def test_alerts_and_health_absent_evaluator(self, tmp_path):
+        """No evaluator wired: /alerts serves an empty list and /health
+        says ok — absence of alerting is not unhealth."""
+        reg = MetricsRegistry()
+        store = TelemetryStore(reg, window_s=0.0, history=8)
+        srv = ProbeServer(0, metrics=reg, telemetry=store)
+        with srv:
+            srv.start()
+            alerts = json.loads(fetch(srv.port, "GET /alerts\n"))
+            health = json.loads(fetch(srv.port, "GET /health\n"))
+        assert alerts["alerts"] == []
+        assert health["status"] == "ok" and health["active"] == 0
+
+    def test_staleness_stamps_advance_between_polls(self, tmp_path):
+        """served_at_s is monotonic within one server — two polls of
+        the same daemon must be orderable without wall clocks."""
+        _, srv = make_server(tmp_path)
+        with srv:
+            srv.start()
+            a = json.loads(fetch(srv.port, "GET /health\n"))
+            time.sleep(0.01)
+            b = json.loads(fetch(srv.port, "GET /health\n"))
+        assert b["served_at_s"] > a["served_at_s"]
+        assert b["uptime_s"] > a["uptime_s"]
 
     def test_get_prefix_is_optional_and_default_is_snapshot(
             self, tmp_path):
@@ -90,7 +139,15 @@ class TestRoutes:
             with_get = fetch(srv.port, "GET /snapshot\n")
             bare = fetch(srv.port, "/snapshot\n")
             empty = fetch(srv.port, "\n")
-        assert with_get == bare == empty
+
+        # the staleness stamps advance between polls by design, so
+        # equality holds modulo them
+        def body(raw):
+            d = json.loads(raw)
+            d.pop("served_at_s"), d.pop("uptime_s")
+            return d
+
+        assert body(with_get) == body(bare) == body(empty)
 
     def test_journal_route_serves_file_entries(self, tmp_path):
         lines = [{"kind": "span", "span_id": 1, "shuffle_id": 3},
@@ -131,7 +188,8 @@ class TestRoutes:
             srv.start()
             err = json.loads(fetch(srv.port, "GET /nope\n"))
         assert "unknown path" in err["error"]
-        assert set(err["paths"]) == {"/journal", "/snapshot", "/metrics"}
+        assert set(err["paths"]) == {"/journal", "/snapshot", "/metrics",
+                                     "/alerts", "/health"}
 
     def test_request_counter(self, tmp_path):
         reg, srv = make_server(tmp_path)

@@ -20,7 +20,8 @@ import pytest
 
 from sparkrdma_tpu.obs.metrics import MetricsRegistry
 from sparkrdma_tpu.obs.tsdb import (DEFAULT_HISTORY, NULL_TELEMETRY,
-                                    TelemetryStore)
+                                    ZERO_WINDOWED, TelemetryStore,
+                                    Windowed)
 
 
 class FakeClock:
@@ -109,22 +110,54 @@ class TestQueries:
             store.sample()
             seen.append(c.value)
             clk.tick(2.0)
-        assert store.delta("shuffle.bytes") == seen[-1] - seen[0]
+        assert store.delta("shuffle.bytes") == \
+            Windowed(seen[-1] - seen[0], 8.0)
         # 4 ticks of 2s between first and last sample
         assert store.rate("shuffle.bytes") == \
-            (seen[-1] - seen[0]) / 8.0
+            Windowed((seen[-1] - seen[0]) / 8.0, 8.0)
         # trailing window: last 2 samples only (newest at t, prev t-2)
         assert store.delta("shuffle.bytes", span_s=2.0) == \
-            seen[-1] - seen[-2]
+            Windowed(seen[-1] - seen[-2], 2.0)
+
+    def test_effective_window_honest_after_eviction(self):
+        """A delta over a requested 30s window answered from a ring
+        that only holds 3s of history must SAY it covered 3s —
+        ``effective_s`` is the actual endpoint spread, so alert rules
+        can scale or discard short answers instead of overstating
+        calm (the eviction-boundary contract)."""
+        reg, store = make_store(history=4)
+        clk = store._clock
+        c = reg.counter("shuffle.bytes")
+        for _ in range(10):       # 10 samples into a 4-deep ring
+            c.inc(50)
+            store.sample()
+            clk.tick(1.0)
+        assert store.evicted == 6
+        # ring now holds 4 points spanning 3s; ask for 30s anyway
+        d = store.delta("shuffle.bytes", span_s=30.0)
+        assert d == Windowed(150.0, 3.0), \
+            "effective_s must report the 3s the ring actually covered"
+        r = store.rate("shuffle.bytes", span_s=30.0)
+        assert r == Windowed(50.0, 3.0)
+        # and the un-evicted young-ring case tells the same truth
+        reg2, store2 = make_store(history=16)
+        reg2.counter("x").inc()
+        store2.sample()
+        store2._clock.tick(0.5)
+        reg2.counter("x").inc()
+        store2.sample()
+        assert store2.delta("x", span_s=30.0).effective_s == 0.5
 
     def test_fewer_than_two_points_is_zero(self):
         reg, store = make_store()
-        assert store.delta("shuffle.records") == 0.0
-        assert store.rate("shuffle.records") == 0.0
+        assert store.delta("shuffle.records") is ZERO_WINDOWED
+        assert store.rate("shuffle.records") is ZERO_WINDOWED
         reg.counter("shuffle.records").inc()
         store.sample()
-        assert store.delta("shuffle.records") == 0.0
-        assert store.rate("shuffle.records") == 0.0
+        assert store.delta("shuffle.records") is ZERO_WINDOWED
+        assert store.rate("shuffle.records") is ZERO_WINDOWED
+        assert ZERO_WINDOWED.value == 0.0
+        assert ZERO_WINDOWED.effective_s == 0.0
 
     def test_zero_elapsed_rate_is_zero(self):
         reg, store = make_store()
@@ -132,7 +165,7 @@ class TestQueries:
         store.sample()
         reg.counter("shuffle.records").inc()
         store.sample()            # same injected clock instant
-        assert store.rate("shuffle.records") == 0.0
+        assert store.rate("shuffle.records") is ZERO_WINDOWED
 
     def test_stats_shape(self):
         reg, store = make_store(history=4, window_s=0.0)
@@ -180,7 +213,8 @@ class TestDisabledPath:
         assert n.window("a") is n.rollup_history(1)
         assert n.stats() is n.stats()
         assert n.last("x") is None
-        assert n.delta("x") == 0.0 and n.rate("x") == 0.0
+        assert n.delta("x") is ZERO_WINDOWED
+        assert n.rate("x") is ZERO_WINDOWED
 
     def test_null_store_noops(self):
         n = NULL_TELEMETRY
