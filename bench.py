@@ -178,6 +178,44 @@ def _bench_metrics(manager) -> dict:
     }
 
 
+def _provenance() -> dict:
+    """Run identity stamped into every BENCH JSON: which code (git
+    SHA), which mesh (geometry), and which knobs (a ShuffleConf content
+    hash) produced the number — the three fields that make two bench
+    lines comparable at a glance, or visibly not. The conf hash covers
+    the *default* ``ShuffleConf`` (so a drifted config.py default the
+    legs silently inherit changes the stamp) plus every explicit
+    ``BENCH_*`` env override; the git SHA is best-effort (empty string
+    outside a git checkout, e.g. a tarball deploy)."""
+    import dataclasses
+    import hashlib
+    import subprocess
+
+    import jax
+
+    from sparkrdma_tpu import ShuffleConf
+
+    sha = ""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))
+        ).stdout.strip()
+    except Exception:
+        pass
+    knobs = {k: v for k, v in sorted(os.environ.items())
+             if k.startswith("BENCH_")}
+    payload = json.dumps(
+        {"conf": dataclasses.asdict(ShuffleConf()), "env": knobs},
+        sort_keys=True, default=str)
+    return {
+        "git_sha": sha,
+        "geometry": f"w{len(jax.devices())}",
+        "conf_hash": hashlib.sha256(payload.encode()).hexdigest()[:16],
+    }
+
+
 def run_width(record_words: int, records_per_device: int,
               repeats: int, journal: str = "", transport: str = "xla"):
     """One full bench leg at ``record_words``; returns ``(gbps, metrics)``
@@ -565,6 +603,7 @@ def main(argv=None) -> int:
         if args.journal:
             metrics["critical_path"] = _critical_path_summary(args.journal)
         single = {
+            "provenance": _provenance(),
             "metric": "terasort_shuffle_gbps_per_chip",
             "value": round(gbps, 3),
             "unit": "GB/s/chip",
@@ -653,6 +692,7 @@ def main(argv=None) -> int:
         oversub_skip = (f"backend is {jax.default_backend()!r}, not tpu — "
                         "out-of-core leg needs real HBM to oversubscribe")
     out = {
+        "provenance": _provenance(),
         "metric": "terasort_shuffle_gbps_per_chip",
         "value": round(faithful, 3),
         "unit": "GB/s/chip",

@@ -36,9 +36,16 @@ grepping ``RdmaShuffleReaderStats`` histograms out of executor logs:
 - alert evidence (schema v11, ``{"kind": "alert"}`` lines): the live
   evaluator's fired/resolved verdicts, which ``--doctor`` reports
   AHEAD of its own heuristics — the evaluator saw the breach happen;
+- job traces (schema v12, ``{"kind": "job"}`` lines + trace-stamped
+  spans): ``--jobs`` prints the per-job tree — every stage with its
+  wall-clock share and merged phase profile, the explicit
+  ``stage:idle`` gap charge, and the job verdict naming the dominant
+  stage and its bottleneck (``obs/trace.py`` writes these at job
+  close);
 - ``--doctor``: rule-based diagnosis mapping symptoms (skew, spills,
   stalls, retries, combinable-but-uncombined shuffles, bottleneck
-  verdicts) to the ShuffleConf knob that addresses them.
+  verdicts, job-dominant stages) to the ShuffleConf knob — or the
+  workload stage — that addresses them.
 
 Rotated journals (``j.jsonl.1``, ``.2``, … from
 ``ShuffleConf.journal_max_bytes``) are walked automatically — pass the
@@ -111,7 +118,7 @@ def split_kinds(entries: List[dict]) -> Dict[str, List[dict]]:
     compat: a v4 journal must not break a v3 report)."""
     out: Dict[str, List[dict]] = {
         "span": [], "stall": [], "rollup": [], "heartbeat": [],
-        "admission": [], "alert": []}
+        "admission": [], "alert": [], "job": []}
     for e in entries:
         k = e.get("kind") or "span"
         if k in out:
@@ -647,6 +654,93 @@ def print_critical_path(cp: dict) -> None:
                   f"+{st['delta_s']:.4f}s ({st['ratio']:.2f}x spread)")
 
 
+def job_report(jobs: List[dict]) -> dict:
+    """Per-job rollup of the schema-v12 ``{"kind": "job"}`` lines.
+
+    Each line is already a closed job's aggregate (``obs/trace.py``
+    built it from the live stage scopes + span attributions); this just
+    shapes them for display, keyed ``trace_id/job``, newest last.
+    Duplicate trace ids (rotated journals re-read) keep the newest line.
+    """
+    out: Dict[str, dict] = {}
+    for jb in sorted(jobs, key=lambda e: float(e.get("ts", 0.0) or 0.0)):
+        key = f"{jb.get('trace_id', '') or '?'}/{jb.get('job', '') or '?'}"
+        wall = float(jb.get("wall_s", 0.0) or 0.0)
+        phases = {str(p): float(v or 0.0)
+                  for p, v in (jb.get("phase_s") or {}).items()}
+        stages = []
+        for st in jb.get("stages") or []:
+            if not isinstance(st, dict):
+                continue
+            s_wall = float(st.get("wall_s", 0.0) or 0.0)
+            s_ph = {str(p): float(v or 0.0)
+                    for p, v in (st.get("phase_s") or {}).items()}
+            s_total = sum(s_ph.values())
+            top = sorted(((p, v) for p, v in s_ph.items()
+                          if p != "other" and v > 0),
+                         key=lambda kv: kv[1], reverse=True)[:2]
+            stages.append({
+                "stage": str(st.get("stage", "") or "?"),
+                "attempt": int(st.get("attempt", 0) or 0),
+                "wall_s": round(s_wall, 6),
+                "wall_share": round(s_wall / wall, 4) if wall > 0 else 0.0,
+                "spans": int(st.get("spans", 0) or 0),
+                "records": int(st.get("records", 0) or 0),
+                "bytes": int(st.get("bytes", 0) or 0),
+                "bottleneck": str(st.get("bottleneck", "") or ""),
+                "phase_s": {p: round(v, 6) for p, v in s_ph.items()},
+                "phase_share": {
+                    p: round(v / s_total, 4) if s_total > 0 else 0.0
+                    for p, v in s_ph.items()},
+                "top_phases": [{"phase": p, "seconds": round(v, 6)}
+                               for p, v in top],
+            })
+        out[key] = {
+            "job": str(jb.get("job", "") or "?"),
+            "trace_id": str(jb.get("trace_id", "") or ""),
+            "tenant": str(jb.get("tenant", "") or ""),
+            "wall_s": round(wall, 6),
+            "stage_idle_s": round(
+                float(jb.get("stage_idle_s", 0.0) or 0.0), 6),
+            "stage_count": int(jb.get("stage_count", 0) or 0),
+            "spans": int(jb.get("spans", 0) or 0),
+            "records": int(jb.get("records", 0) or 0),
+            "bytes": int(jb.get("bytes", 0) or 0),
+            "dominant_stage": str(jb.get("dominant_stage", "") or ""),
+            "bottleneck": str(jb.get("bottleneck", "") or ""),
+            "phase_s": {p: round(v, 6) for p, v in phases.items()},
+            "stages": stages,
+        }
+    return out
+
+
+def print_jobs(jobs_rep: dict) -> None:
+    print(f"job traces (schema v12, {len(jobs_rep)} job(s)):")
+    for key, jb in jobs_rep.items():
+        verdict = jb["bottleneck"] or "unattributed"
+        dom = jb["dominant_stage"] or "?"
+        tenant = f"  tenant={jb['tenant']}" if jb["tenant"] else ""
+        print(f"  job {jb['job']} [{jb['trace_id']}]{tenant}: "
+              f"wall {jb['wall_s']:.4f}s, {jb['stage_count']} stage(s) "
+              f"+ {jb['stage_idle_s']:.4f}s idle, {jb['spans']} span(s), "
+              f"{jb['records']:,} records")
+        print(f"    verdict: dominant stage '{dom}' is {verdict}")
+        stages = jb["stages"]
+        for i, st in enumerate(stages):
+            tee = "└─" if i == len(stages) - 1 else "├─"
+            name = st["stage"]
+            if st["attempt"]:
+                name = f"{name}#{st['attempt']}"
+            parts = "  ".join(
+                f"{t['phase']}={t['seconds']:.4f}s"
+                f" ({st['phase_share'].get(t['phase'], 0.0):.0%})"
+                for t in st["top_phases"])
+            bn = f"  [{st['bottleneck']}]" if st["bottleneck"] else ""
+            print(f"    {tee} {name:<16} {st['wall_s']:>9.4f}s "
+                  f"{st['wall_share']:>6.1%}  {st['spans']} span(s)"
+                  f"{('  ' + parts) if parts else ''}{bn}")
+
+
 #: skew past this ratio is a geometry problem, not noise — matches the
 #: skew-split planner's own intervention threshold territory
 DOCTOR_SKEW_THRESHOLD = 4.0
@@ -729,8 +823,63 @@ def _alert_evidence(alerts: Sequence[dict]) -> List[str]:
     return out
 
 
+#: stage-targeted remediation for ``--doctor`` on traced jobs: when a
+#: job's wall-clock is dominated by one stage, the advice names the
+#: knob or restructuring that moves THAT stage, not a generic shuffle
+#: tip. Keys are pinned to ``obs.trace.STAGE_VOCAB`` by the srlint
+#: span-schema-sync family (lint/rules_sync.py).
+STAGE_ADVICE = {
+    "co_partition": "the co-partitioning exchanges dominate — check the "
+                    "per-shuffle skew and wire-reduction sections; a "
+                    "range partitioner with better splitters or "
+                    "projection pushdown shrinks this stage",
+    "probe_join": "the post-shuffle probe join dominates — it is local "
+                  "compute, so look at the device-side sort/probe "
+                  "geometry (capacity padding) rather than shuffle knobs",
+    "item_join": "the first dimension join dominates — its two "
+                 "co-partition exchanges ship the full fact table; "
+                 "consider projecting unused payload words before the "
+                 "exchange (pushdown) or combining dimension lookups",
+    "store_join": "the second dimension join dominates — the enriched "
+                  "fact re-shuffles here; push the region predicate "
+                  "earlier so non-qualifying rows drop before this wire",
+    "group_agg": "the grouped aggregation dominates — make sure the "
+                 "fused aggregator and map-side combine are on "
+                 '(ShuffleConf(map_side_combine="on")) so duplicate '
+                 "keys collapse before the fabric",
+    "rank_update": "the per-iteration rank shuffle dominates — enable "
+                   "map-side combine (power-law graphs collapse "
+                   "many-to-one contributions) and reuse the cached "
+                   "plan across iterations",
+    "update_users": "the user half-step dominates — partial "
+                    "normal-equation records are sum-combinable, so "
+                    'force ShuffleConf(map_side_combine="on") and check '
+                    "the combine ratio in the wire section",
+    "update_items": "the item half-step dominates — same remedy as the "
+                    "user half-step: map-side combine + cached plans",
+    "publish": "staging input chunks into the tiered store dominates — "
+               "raise spill_tier_host_bytes so publication is not "
+               "throttled by eviction, and check disk write bandwidth",
+    "chunk_sort": "the per-chunk exchanges dominate — check the "
+                  "prefetch hit rate (spill_tier_prefetch) so chunk "
+                  "j+1 is HBM-resident before chunk j finishes",
+    "collect": "host-side collection dominates — run with "
+               "collect=False for throughput benchmarking, or keep "
+               "results device-resident",
+    "sort_by_key": "the range-partitioned sort exchange dominates — "
+                   "check splitter balance (skew section) and "
+                   "sampling fidelity (samples per device)",
+    "reduce_by_key": "the aggregating exchange dominates — confirm "
+                     "map-side combine engaged (wire section ratio)",
+    "join": "the co-partitioning for a join dominates — both sides "
+            "reshuffle; pre-partition the smaller side once and reuse "
+            "it across joins if the pipeline repeats",
+}
+
+
 def diagnose(spans: List[dict], stalls: List[dict],
-             alerts: Sequence[dict] = ()) -> List[str]:
+             alerts: Sequence[dict] = (),
+             jobs: Sequence[dict] = ()) -> List[str]:
     """Rule-based symptom -> knob mapping (the --doctor section).
 
     Journaled ``alert`` lines are first-class evidence, reported AHEAD
@@ -903,6 +1052,30 @@ def diagnose(spans: List[dict], stalls: List[dict],
         findings.append(
             f"shuffle(s) {sids} are {verdict}: "
             f"{verdict_advice[verdict]}")
+    # job verdicts (schema v12): each traced job's dominant stage maps
+    # to stage-targeted remediation instead of a generic shuffle tip
+    for _key, job_cell in job_report(list(jobs)).items():
+        dom = job_cell["dominant_stage"]
+        if not dom:
+            continue
+        share = max((st["wall_share"] for st in job_cell["stages"]
+                     if st["stage"] == dom), default=0.0)
+        verdict = job_cell["bottleneck"] or "unattributed"
+        advice = STAGE_ADVICE.get(dom)
+        if advice:
+            findings.append(
+                f"job '{job_cell['job']}' [{job_cell['trace_id']}] "
+                f"spends {share:.0%} of its wall-clock in stage "
+                f"'{dom}' ({verdict}): {advice}")
+        wall = job_cell["wall_s"]
+        idle = job_cell["stage_idle_s"]
+        if wall > 0 and idle / wall >= 0.25:
+            findings.append(
+                f"job '{job_cell['job']}' [{job_cell['trace_id']}] "
+                f"spends {idle / wall:.0%} of its wall-clock BETWEEN "
+                "stages (stage:idle) — the driver-side glue (host "
+                "prep, splitter sampling, result collection) is the "
+                "bottleneck, not any shuffle stage")
     corrupt = [e for s in spans for e in (s.get("events") or [])
                if e.get("name") == "fault:injected"
                and e.get("action") == "corrupt"]
@@ -1012,18 +1185,18 @@ def print_report(rep: dict, top: int) -> None:
             print(f"  projection pushdown: "
                   f"{wr['pushdown_words_dropped']:,} payload words "
                   "off the wire")
-    st = rep.get("store") or {}
-    if st.get("spill_bytes") or st.get("fetch_bytes"):
-        hits = st.get("prefetch_hit_rate")
+    store = rep.get("store") or {}
+    if store.get("spill_bytes") or store.get("fetch_bytes"):
+        hits = store.get("prefetch_hit_rate")
         hit_str = f"{hits:.1%}" if hits is not None else "n/a"
         print("tiered store (out-of-core, cumulative, all processes):")
-        print(f"  spilled: {_fmt_bytes(st['spill_bytes'])} "
-              f"({st['spill_mbps']:,.1f} MB/s overlapped)   "
-              f"fetched: {_fmt_bytes(st['fetch_bytes'])} "
-              f"({st['fetch_mbps']:,.1f} MB/s overlapped)")
+        print(f"  spilled: {_fmt_bytes(store['spill_bytes'])} "
+              f"({store['spill_mbps']:,.1f} MB/s overlapped)   "
+              f"fetched: {_fmt_bytes(store['fetch_bytes'])} "
+              f"({store['fetch_mbps']:,.1f} MB/s overlapped)")
         print(f"  prefetch hit rate: {hit_str} "
-              f"({st['prefetch_hits']} hits / "
-              f"{st['sync_fetches']} synchronous fetches)")
+              f"({store['prefetch_hits']} hits / "
+              f"{store['sync_fetches']} synchronous fetches)")
     print("per-peer received records (all spans):")
     peers = rep["per_peer_records"]
     total = sum(peers.values()) or 1
@@ -1137,6 +1310,10 @@ def main(argv=None) -> int:
                     help="spans to list in the skew report (default 3)")
     ap.add_argument("--doctor", action="store_true",
                     help="print rule-based diagnosis (symptom -> knob)")
+    ap.add_argument("--jobs", action="store_true",
+                    help="print the per-job trace tree (schema v12 "
+                         '{"kind": "job"} lines: stages, phase shares, '
+                         "job verdicts)")
     args = ap.parse_args(argv)
     spans: List[dict] = []
     stalls: List[dict] = []
@@ -1144,6 +1321,7 @@ def main(argv=None) -> int:
     heartbeats: List[dict] = []
     admissions: List[dict] = []
     alerts: List[dict] = []
+    jobs: List[dict] = []
     for path in args.journals:
         kinds = split_kinds(load_entries(path))
         spans.extend(kinds["span"])
@@ -1152,6 +1330,7 @@ def main(argv=None) -> int:
         heartbeats.extend(kinds["heartbeat"])
         admissions.extend(kinds["admission"])
         alerts.extend(kinds["alert"])
+        jobs.extend(kinds["job"])
     rep = aggregate(spans)
     cp_rep = critical_path_report(spans)
     tenant_rep = tenant_breakdown({
@@ -1161,6 +1340,7 @@ def main(argv=None) -> int:
                                                      "per_shuffle": {}}
     roll_rep = aggregate_rollups(rollups)
     hb_rep = heartbeat_summary(heartbeats)
+    jobs_rep = job_report(jobs)
     multi_host = len(hosts_rep["hosts"]) > 1
     if args.json:
         rep["hosts"] = hosts_rep
@@ -1169,14 +1349,22 @@ def main(argv=None) -> int:
         rep["rollups"] = roll_rep
         rep["heartbeats"] = hb_rep
         rep["tenants"] = tenant_rep["tenants"]
+        rep["jobs"] = jobs_rep
         if args.doctor:
-            rep["doctor"] = diagnose(spans, stalls, alerts)
+            rep["doctor"] = diagnose(spans, stalls, alerts, jobs)
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
         print_report(rep, args.top)
         if cp_rep:
             print_critical_path(cp_rep)
+        if jobs_rep and (args.jobs or not spans):
+            # --jobs prints the tree explicitly; a journal of ONLY job
+            # lines (spans sampled away) prints it unconditionally
+            print_jobs(jobs_rep)
+        elif args.jobs:
+            print("job traces: none recorded (run under "
+                  "`manager.job(...)` to trace)")
         if roll_rep.get("windows"):
             print_rollups(roll_rep)
         if hb_rep["hosts"]:
@@ -1189,7 +1377,7 @@ def main(argv=None) -> int:
             print_stalls(stalls)
         if args.doctor:
             print("doctor:")
-            for line in diagnose(spans, stalls, alerts):
+            for line in diagnose(spans, stalls, alerts, jobs):
                 print(f"  - {line}")
     return 0
 

@@ -15,7 +15,11 @@ two-table view:
 - **tenants** (when the journal came from a multi-tenant
   ``ShuffleService``): per-tenant tier usage from the daemon
   heartbeat's usage probe plus admission-wait counts from the
-  fair-queueing ``admission`` lines.
+  fair-queueing ``admission`` lines;
+- **jobs** (schema v12, journals written under ``manager.job(...)``):
+  one row per traced job — stage count, wall-clock, inter-stage idle,
+  dominant stage and verdict — and the shuffle table grows JOB/STAGE
+  columns from the trace coordinates stamped on spans and rollups.
 
 Rotated segments (``journal.jsonl.1``, … from
 ``ShuffleConf.journal_max_bytes``) are discovered and merged
@@ -113,7 +117,7 @@ def bucket_entries(entries: List[dict],
     is what keeps ``--connect`` output identical to the file path."""
     if kinds is None:
         kinds = {"span": [], "stall": [], "rollup": [], "heartbeat": [],
-                 "admission": [], "alert": []}
+                 "admission": [], "alert": [], "job": []}
     for entry in entries:
         kind = entry.get("kind") or "span"
         if kind in kinds:
@@ -337,8 +341,8 @@ def build_host_rows(
             rows[pidx].fetch_mb_s = (hi[1] - lo[1]) / rate_window_s / (
                 1024.0 * 1024.0)
 
-    for st in kinds["stall"]:
-        row(int(st.get("process_index", 0) or 0)).stalls += 1
+    for sl in kinds["stall"]:
+        row(int(sl.get("process_index", 0) or 0)).stalls += 1
 
     # rollup windows cover sampled-out spans: take the better rate estimate
     win_bytes: Dict[int, float] = {}
@@ -376,7 +380,7 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
             shuffles[k] = {"tenant": tenant, "shuffle_id": sid,
                            "reads": 0, "records": 0,
                            "bytes": 0, "spills": 0, "retries": 0,
-                           "sync_fetches": 0,
+                           "sync_fetches": 0, "job": "", "stage": "",
                            "lat": [], "p95_ms": 0.0, "exact": False}
         return shuffles[k]
 
@@ -384,6 +388,10 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
         c = cell(str(rb.get("tenant", "") or ""),
                  int(rb.get("shuffle_id", 0) or 0))
         c["exact"] = True
+        # trace coordinates (schema v12): newest window wins
+        if rb.get("job"):
+            c["job"] = str(rb.get("job") or "")
+            c["stage"] = str(rb.get("stage") or "")
         c["reads"] += int(rb.get("reads", 0) or 0)
         c["records"] += int(rb.get("records", 0) or 0)
         c["bytes"] += int(rb.get("bytes", 0) or 0)
@@ -398,6 +406,9 @@ def build_shuffle_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
         c = cell(str(s.get("tenant", "") or ""),
                  int(s.get("shuffle_id", 0) or 0))
         c["lat"].append(span_latency_ms(s))
+        if s.get("job"):
+            c["job"] = str(s.get("job") or "")
+            c["stage"] = str(s.get("stage") or "")
         if not c["exact"]:  # no rollups in this journal: estimate from spans
             w = int(s.get("sample_weight", 1) or 1)
             c["reads"] += w
@@ -453,6 +464,29 @@ def build_tenant_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
     return [tenants[k] for k in sorted(tenants)]
 
 
+def build_job_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
+    """One row per traced job from the schema-v12 ``{"kind": "job"}``
+    lines (written at job close). Duplicate trace ids — rotated
+    segments re-read — keep the newest line."""
+    rows: Dict[str, dict] = {}
+    for jb in sorted(kinds.get("job", []),
+                     key=lambda e: float(e.get("ts", 0.0) or 0.0)):
+        key = f"{jb.get('trace_id', '') or '?'}/{jb.get('job', '') or '?'}"
+        rows[key] = {
+            "job": str(jb.get("job", "") or "?"),
+            "trace_id": str(jb.get("trace_id", "") or ""),
+            "tenant": str(jb.get("tenant", "") or ""),
+            "wall_s": float(jb.get("wall_s", 0.0) or 0.0),
+            "stage_idle_s": float(jb.get("stage_idle_s", 0.0) or 0.0),
+            "stages": int(jb.get("stage_count", 0) or 0),
+            "spans": int(jb.get("spans", 0) or 0),
+            "records": int(jb.get("records", 0) or 0),
+            "dominant": str(jb.get("dominant_stage", "") or ""),
+            "verdict": str(jb.get("bottleneck", "") or ""),
+        }
+    return [rows[k] for k in sorted(rows)]
+
+
 def build_alert_rows(kinds: Dict[str, List[dict]]) -> List[dict]:
     """Currently-active alerts replayed from journaled ``alert`` lines:
     per (rule, dedup) key the newest ``fired`` not followed by a
@@ -487,7 +521,8 @@ def render(
         f"{n_spans} spans{sampled}, {len(kinds['rollup'])} rollup window(s), "
         f"{len(kinds['stall'])} stall(s), "
         f"{len(kinds.get('admission', []))} admission wait(s), "
-        f"{len(kinds.get('alert', []))} alert line(s)")
+        f"{len(kinds.get('alert', []))} alert line(s), "
+        f"{len(kinds.get('job', []))} job trace(s)")
     lines.append("")
     lines.append(f"{'HOST':>4}  {'NAME':<14} {'PID':>7} {'HB AGE':>7} "
                  f"{'INFL':>4} {'POOL':>4} {'RSS':>8} {'READS/S':>8} "
@@ -509,15 +544,19 @@ def render(
     if not hosts:
         lines.append("  (no entries yet)")
     lines.append("")
-    lines.append(f"{'SHUFFLE':>7}  {'TENANT':<10} {'READS':>8} "
+    lines.append(f"{'SHUFFLE':>7}  {'TENANT':<10} {'JOB':<12} "
+                 f"{'STAGE':<14} {'READS':>8} "
                  f"{'RECORDS':>12} "
                  f"{'BYTES':>10} {'P95MS':>8} {'SPILL':>5} {'RETRY':>5} "
                  f"{'SYNCF':>5}  SRC")
     for c in shuffles:
         src = "rollup" if c["exact"] else "spans"
         tenant = c["tenant"] or "-"
+        job = c["job"] or "-"
+        stage = c["stage"] or "-"
         lines.append(
-            f"{c['shuffle_id']:>7}  {tenant[:10]:<10} {c['reads']:>8} "
+            f"{c['shuffle_id']:>7}  {tenant[:10]:<10} {job[:12]:<12} "
+            f"{stage[:14]:<14} {c['reads']:>8} "
             f"{c['records']:>12} "
             f"{_fmt_bytes(float(c['bytes'])):>10} {c['p95_ms']:>8.1f} "
             f"{c['spills']:>5} {c['retries']:>5} "
@@ -533,6 +572,21 @@ def render(
                 f"{_fmt_bytes(float(c['host'])):>10} "
                 f"{_fmt_bytes(float(c['disk'])):>10} "
                 f"{c['waits']:>6} {c['wait_ms']:>9.1f}")
+    jobs = build_job_rows(kinds)
+    if jobs:
+        lines.append("")
+        lines.append(f"{'JOB':<14} {'TRACE':<14} {'TENANT':<10} "
+                     f"{'STAGES':>6} {'WALL S':>9} {'IDLE S':>8} "
+                     f"{'SPANS':>5} {'RECORDS':>10} {'DOMINANT':<14} "
+                     "VERDICT")
+        for jr in jobs:
+            lines.append(
+                f"{jr['job'][:14]:<14} {jr['trace_id'][:14]:<14} "
+                f"{(jr['tenant'] or '-')[:10]:<10} {jr['stages']:>6} "
+                f"{jr['wall_s']:>9.4f} {jr['stage_idle_s']:>8.4f} "
+                f"{jr['spans']:>5} {jr['records']:>10} "
+                f"{(jr['dominant'] or '-')[:14]:<14} "
+                f"{jr['verdict'] or '-'}")
     alerts = build_alert_rows(kinds)
     if alerts:
         lines.append("")

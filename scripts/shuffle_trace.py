@@ -23,7 +23,16 @@ into a trace viewable in Perfetto (https://ui.perfetto.dev) or
   (``reads.in_flight``, ``pool.outstanding (hb)``, ``rss_mb``) and
   ``rollup`` lines as windowed counter tracks (``rollup reads``,
   ``rollup p95_ms``) — the long-run telemetry rendered on the same
-  timeline as the spans it summarizes.
+  timeline as the spans it summarizes;
+- journaled ``admission`` waits (the fair-queueing controller) and
+  ``alert`` fire/resolve transitions as process-scoped instants — the
+  quota and breach evidence at the moment it happened;
+- job traces (schema v12 ``{"kind": "job"}`` lines) as their own
+  track group: one Perfetto process per (trace id, job) named
+  ``job <name> [<trace id>]``, the job itself as one slice on its
+  ``job`` track and every stage (``stage#attempt``) as a slice on the
+  ``stages`` track, aligned on the same wall clock as the host tracks
+  so a stage visually brackets the spans it ran.
 
 Rotated journal segments (``j.jsonl.1``, … from
 ``ShuffleConf.journal_max_bytes``) are discovered and walked
@@ -115,15 +124,22 @@ def _phase_slices(span: dict, pid: int) -> List[dict]:
         if dur <= 0.0:
             continue
         start = end - dur
+        args = {
+            "label": label,
+            "rounds": span.get("rounds"),
+            "records": span.get("records"),
+        }
+        # trace coordinates (schema v12): tie the slice to its job's
+        # track group for ones that ran under ``manager.job(...)``
+        if span.get("job"):
+            args["job"] = span.get("job")
+            args["stage"] = span.get("stage")
+            args["trace_id"] = span.get("trace_id")
         out.append({
             "ph": "X", "pid": pid, "tid": 1,
             "name": phase[:-2],  # strip the _s suffix
             "ts": int(start * US), "dur": int(dur * US),
-            "args": {
-                "label": label,
-                "rounds": span.get("rounds"),
-                "records": span.get("records"),
-            },
+            "args": args,
         })
         end = start
     return out
@@ -196,6 +212,89 @@ def _stall_event(entry: dict) -> dict:
     }
 
 
+def _admission_event(entry: dict) -> dict:
+    """A fair-queueing wait -> a process-scoped instant. These lines
+    used to be silently dropped by the unknown-kind skip; the wait is
+    exactly the kind of gap a trace viewer should show."""
+    pid = int(entry.get("process_index", 0) or 0)
+    return {
+        "ph": "i", "pid": pid, "tid": 2, "name": "admission:wait",
+        "ts": int(float(entry.get("ts", 0.0)) * US),
+        "s": "p",
+        "args": {k: v for k, v in entry.items() if k not in ("ts", "kind")},
+    }
+
+
+def _alert_event(entry: dict) -> dict:
+    """An alert fire/resolve transition -> a process-scoped instant."""
+    pid = int(entry.get("process_index", 0) or 0)
+    name = (f"ALERT {entry.get('event', '?')}: "
+            f"{entry.get('rule', '?')}")
+    return {
+        "ph": "i", "pid": pid, "tid": 2, "name": name,
+        "ts": int(float(entry.get("ts", 0.0)) * US),
+        "s": "p",
+        "args": {k: v for k, v in entry.items() if k not in ("ts", "kind")},
+    }
+
+
+#: pid block where per-job track groups start — far above any plausible
+#: ``process_index``, so job tracks never collide with host tracks
+_JOB_PID_BASE = 1000
+
+
+def _job_events(jb: dict, pid: int) -> List[dict]:
+    """One ``{"kind": "job"}`` line -> its own Perfetto track group.
+
+    The job line carries absolute ``start_ts`` stamps for itself and
+    each stage record, so the slices land on the same wall clock as the
+    host tracks: a stage slice visually brackets the span phase slices
+    that ran under it."""
+    job = str(jb.get("job", "") or "job")
+    trace_id = str(jb.get("trace_id", "") or "")
+    out = [
+        _meta(pid, f"job {job} [{trace_id}]"),
+        _thread_meta(pid, 1, "job"),
+        _thread_meta(pid, 2, "stages"),
+    ]
+    start = float(jb.get("start_ts", 0.0) or 0.0)
+    wall = float(jb.get("wall_s", 0.0) or 0.0)
+    out.append({
+        "ph": "X", "pid": pid, "tid": 1, "name": job,
+        "ts": int(start * US), "dur": int(wall * US),
+        "args": {
+            "trace_id": trace_id,
+            "tenant": jb.get("tenant"),
+            "stage_idle_s": jb.get("stage_idle_s"),
+            "spans": jb.get("spans"),
+            "records": jb.get("records"),
+            "dominant_stage": jb.get("dominant_stage"),
+            "bottleneck": jb.get("bottleneck"),
+            "phase_s": jb.get("phase_s"),
+        },
+    })
+    for st in jb.get("stages") or []:
+        if not isinstance(st, dict):
+            continue
+        name = str(st.get("stage", "") or "stage")
+        attempt = int(st.get("attempt", 0) or 0)
+        if attempt:
+            name = f"{name}#{attempt}"
+        out.append({
+            "ph": "X", "pid": pid, "tid": 2, "name": name,
+            "ts": int(float(st.get("start_ts", 0.0) or 0.0) * US),
+            "dur": int(float(st.get("wall_s", 0.0) or 0.0) * US),
+            "args": {
+                "spans": st.get("spans"),
+                "records": st.get("records"),
+                "bytes": st.get("bytes"),
+                "bottleneck": st.get("bottleneck"),
+                "phase_s": st.get("phase_s"),
+            },
+        })
+    return out
+
+
 def _heartbeat_events(hb: dict) -> List[dict]:
     """One heartbeat line -> counter samples on its host's track."""
     pid = int(hb.get("process_index", 0) or 0)
@@ -236,6 +335,7 @@ def build_trace(journals: Dict[str, List[dict]]) -> dict:
     """
     trace_events: List[dict] = []
     hosts_seen: Dict[int, int] = {}
+    job_pids: Dict[Tuple[str, str], int] = {}
     for src, entries in journals.items():
         for entry in entries:
             kind = entry.get("kind")
@@ -247,6 +347,21 @@ def build_trace(journals: Dict[str, List[dict]]) -> dict:
                 continue
             if kind == "rollup":
                 trace_events.extend(_rollup_events(entry))
+                continue
+            if kind == "admission":
+                trace_events.append(_admission_event(entry))
+                continue
+            if kind == "alert":
+                trace_events.append(_alert_event(entry))
+                continue
+            if kind == "job":
+                key = (str(entry.get("trace_id", "") or ""),
+                       str(entry.get("job", "") or ""))
+                pid = job_pids.get(key)
+                if pid is None:
+                    pid = _JOB_PID_BASE + len(job_pids)
+                    job_pids[key] = pid
+                trace_events.extend(_job_events(entry, pid))
                 continue
             if kind not in (None, "span"):
                 continue  # unknown auxiliary kinds: forward-compat skip

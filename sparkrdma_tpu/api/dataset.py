@@ -40,6 +40,7 @@ from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import (hash_partitioner,
                                                  range_partitioner)
 from sparkrdma_tpu.meta.sampling import compute_splitters, make_sampler
+from sparkrdma_tpu.obs import trace as _trace
 
 #: Dataset-layer shuffle ids live in their own range to stay clear of
 #: explicitly-managed shuffles on the same manager.
@@ -557,7 +558,22 @@ class Dataset:
     def _exchange(self, partitioner: Callable, num_parts: int,
                   key_ordering: bool = False,
                   aggregator: Optional[str] = None,
-                  float_payload: bool = False) -> "Dataset":
+                  float_payload: bool = False,
+                  op: str = "exchange") -> "Dataset":
+        m = self.manager
+        # job tracing: when this pipeline runs under `manager.job(...)`
+        # each exchange-backed op self-annotates as a stage named after
+        # the op — unless the caller already opened an explicit stage,
+        # which wins (trace.auto_stage defers to open scopes)
+        with _trace.auto_stage(op):
+            return self._exchange_traced(
+                partitioner, num_parts, key_ordering, aggregator,
+                float_payload)
+
+    def _exchange_traced(self, partitioner: Callable, num_parts: int,
+                         key_ordering: bool = False,
+                         aggregator: Optional[str] = None,
+                         float_payload: bool = False) -> "Dataset":
         m = self.manager
         # consume pending logical ops: they fuse into the exchange
         # program (filtered rows never occupy a round slot; projected
@@ -788,7 +804,7 @@ class Dataset:
         m = self.manager
         num_parts = num_parts or m.runtime.num_partitions
         part = hash_partitioner(num_parts, m.conf.key_words)
-        return self._exchange(part, num_parts)
+        return self._exchange(part, num_parts, op="repartition")
 
     def sort_by_key(self, samples_per_device: int = 256) -> "Dataset":
         """Globally sort by the key words (rdd.sortByKey): sample ->
@@ -807,7 +823,8 @@ class Dataset:
         splitters = compute_splitters(samples, rt.num_partitions)
         part = range_partitioner(splitters, m.conf.key_words)
         ds = Dataset(m, records, schema=base.schema)
-        return ds._exchange(part, rt.num_partitions, key_ordering=True)
+        return ds._exchange(part, rt.num_partitions, key_ordering=True,
+                            op="sort_by_key")
 
     def reduce_by_key(self, op: str = "sum",
                       float_payload: bool = False) -> "Dataset":
@@ -817,7 +834,8 @@ class Dataset:
         num_parts = m.runtime.num_partitions
         part = hash_partitioner(num_parts, m.conf.key_words)
         return self._exchange(part, num_parts, aggregator=op,
-                              float_payload=float_payload)
+                              float_payload=float_payload,
+                              op="reduce_by_key")
 
     def distinct(self) -> "Dataset":
         """Unique FULL rows (rdd.distinct): duplicates are co-located by
@@ -839,7 +857,7 @@ class Dataset:
             return (h % jnp.uint32(num_parts)).astype(jnp.int32)
 
         full_row_hash.cache_key = ("fullhash", num_parts, w)
-        a = self._exchange(full_row_hash, num_parts)
+        a = self._exchange(full_row_hash, num_parts, op="distinct")
         cap = a.records.shape[1] // num_parts
 
         cache = _join_programs.setdefault(m, {})
@@ -951,7 +969,7 @@ class Dataset:
         m = self.manager
         num_parts = m.runtime.num_partitions
         part = hash_partitioner(num_parts, m.conf.key_words)
-        a = self._exchange(part, num_parts)
+        a = self._exchange(part, num_parts, op="group_by_key")
         cap = a.records.shape[1] // num_parts
         fn = self._grouping_program(cap)
         values, groups, n_groups, totals = fn(a.records, a.totals)
@@ -971,8 +989,8 @@ class Dataset:
         kw = m.conf.key_words
         num_parts = m.runtime.num_partitions
         part = hash_partitioner(num_parts, kw)
-        a = self._exchange(part, num_parts)
-        b = other._exchange(part, num_parts)
+        a = self._exchange(part, num_parts, op="cogroup")
+        b = other._exchange(part, num_parts, op="cogroup")
         ca = a.records.shape[1] // num_parts
         cb = b.records.shape[1] // num_parts
         ga = self._grouping_program(ca)
@@ -1020,8 +1038,8 @@ class Dataset:
         pay_ix = m.conf.key_words            # first payload word
         num_parts = rt.num_partitions
         part = _low_word_hash(num_parts, key_ix)
-        a = self._exchange(part, num_parts)
-        b = other._exchange(part, num_parts)
+        a = self._exchange(part, num_parts, op="join")
+        b = other._exchange(part, num_parts, op="join")
         ca = a.records.shape[1] // num_parts
         cb = b.records.shape[1] // num_parts
         fn = _join_program(m, ca, cb, key_ix, pay_ix)
@@ -1055,8 +1073,8 @@ class Dataset:
         key_ix = m.conf.key_words - 1
         num_parts = rt.num_partitions
         part = _low_word_hash(num_parts, key_ix)
-        a = self._exchange(part, num_parts)
-        b = other._exchange(part, num_parts)
+        a = self._exchange(part, num_parts, op="join")
+        b = other._exchange(part, num_parts, op="join")
         ca = a.records.shape[1] // num_parts
         cb = b.records.shape[1] // num_parts
         if out_capacity is None:

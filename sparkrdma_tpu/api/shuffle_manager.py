@@ -52,6 +52,7 @@ from sparkrdma_tpu.kernels.sort import lexsort_cols
 from sparkrdma_tpu.meta.checkpoint import MapOutputStore
 from sparkrdma_tpu.meta.map_output import MapOutputRegistry
 from sparkrdma_tpu.obs import critical_path
+from sparkrdma_tpu.obs import trace as _trace
 from sparkrdma_tpu.obs.alerts import AlertEvaluator
 from sparkrdma_tpu.obs.baseline import BaselineStore
 from sparkrdma_tpu.obs.journal import ExchangeJournal, ExchangeSpan, next_span_id
@@ -467,10 +468,20 @@ class ShuffleReader:
                     # of this read's exchange (per-span, not cumulative)
                     **ex.wire_stats(),
                 )
+                # schema v12: job-trace coordinates of whatever job /
+                # stage scope this read ran under (defaults outside one)
+                tctx = _trace.current_trace()
+                if tctx is not None:
+                    span.trace_id = tctx.trace_id
+                    span.job = tctx.job
+                    span.stage = tctx.stage
+                    span.stage_attempt = tctx.stage_attempt
                 # schema v10: phase attribution + bottleneck verdict,
                 # derived from the drained events before sampling so
                 # the rollup observes the enriched span too
                 critical_path.enrich(span, metrics=self._m.metrics)
+                # feed the attribution back into the job's stage profile
+                _trace.observe_active_span(span)
                 # sampling decides whether the full span lands; the
                 # rollup folds the read either way, so window totals
                 # stay exact under any journal_sample
@@ -757,7 +768,8 @@ class ShuffleManager:
                     alerts=(self.alerts.active
                             if self.alerts is not None else None),
                     health=(self.alerts.health
-                            if self.alerts is not None else None))
+                            if self.alerts is not None else None),
+                    jobs=self.telemetry.job_lines)
                 self.probe.start()
             except OSError:
                 log.warning("probe endpoint failed to bind port %d",
@@ -849,6 +861,26 @@ class ShuffleManager:
         return ShuffleReader(self, handle, start_partition, end_partition,
                              key_ordering, aggregator, float_payload,
                              row_filter, keep_words)
+
+    def job(self, name: str) -> "_trace.JobTrace":
+        """Open a job trace over the exchanges that follow::
+
+            with manager.job("tpcds_q64") as job:
+                with job.stage("item_join"):
+                    ...register / write / read...
+
+        Every span, rollup window, heartbeat and admission line emitted
+        inside the context is stamped with the trace coordinates
+        (journal schema v12); at exit one ``{"kind": "job"}`` summary
+        line lands in the journal — per-stage critical-path profiles,
+        ``stage:idle`` time, the per-job verdict — and feeds the
+        telemetry store's per-job history ring (probe ``/jobs``).
+        See :mod:`sparkrdma_tpu.obs.trace`.
+        """
+        return _trace.JobTrace(
+            name, tenant=self.tenant, journal=self.journal,
+            store=self.telemetry,
+            process_index=self.runtime.process_index)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self._registry.unregister(shuffle_id)

@@ -1668,9 +1668,19 @@ class ShuffleExchange:
                 tenant=self.tenant,
                 **self.wire_stats(),
             )
+            # schema v12: job-trace coordinates of the active job/stage
+            from sparkrdma_tpu.obs import trace as _trace
+            tctx = _trace.current_trace()
+            if tctx is not None:
+                span.trace_id = tctx.trace_id
+                span.job = tctx.job
+                span.stage = tctx.stage
+                span.stage_attempt = tctx.stage_attempt
             # schema v10: phase attribution + bottleneck verdict
             from sparkrdma_tpu.obs import critical_path
             critical_path.enrich(span, metrics=self.metrics)
+            # feed the attribution back into the job's stage profile
+            _trace.observe_active_span(span)
             weight = self.sampler.keep_weight(span_id, t.elapsed)
             if self.rollup is not None:
                 self.rollup.observe(span, kept=weight > 0)

@@ -614,3 +614,122 @@ def check_alert_rule_sync(ctx: LintContext) -> List[Finding]:
                         "obs.alerts.ALERT_FIELDS — rename the field or "
                         "fix the script", obj="scripts"))
     return findings
+
+
+# ---------------------------------------------------------------------
+# trace-schema-sync
+# ---------------------------------------------------------------------
+
+#: job / stage access patterns; by convention the CLIs bind a
+#: ``{"kind": "job"}`` dict to ``jb`` and a per-stage record (one entry
+#: of its ``stages`` list) to ``st``. Both quote styles are accepted —
+#: job readers often sit inside f-strings where the inner delimiter
+#: must be the other quote.
+JOB_GET = re.compile(r'\bjb\.get\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+STAGE_GET = re.compile(r'\bst\.get\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+
+#: workload stage annotations: ``_trace.stage("<name>")`` /
+#: ``trace.stage(...)`` / ``auto_stage(...)`` with a literal name.
+#: Calls passing a variable can't be checked statically and are skipped.
+STAGE_CALL = re.compile(
+    r'\b(?:_?trace\.)?(?:auto_)?stage\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+
+
+def _dict_literal_keys(sf: SourceFile, name: str) -> Optional[tuple]:
+    """(string keys, lineno) of a module-level ``name = {...}`` dict
+    literal, or None when absent / not a literal-keyed dict."""
+    node = module_assign(sf.tree, name)
+    if not isinstance(node, ast.Dict):
+        return None
+    keys = []
+    for k in node.keys:
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.append(k.value)
+    return set(keys), node.lineno
+
+
+@rule("trace-schema-sync",
+      "CLI job/stage-field reads name real JOB_FIELDS/STAGE_FIELDS "
+      "keys, stage-advice tables and workload stage annotations use "
+      "the declared STAGE_VOCAB", kind="schema-sync")
+def check_trace_schema_sync(ctx: LintContext) -> List[Finding]:
+    """Convention the rule pins: CLIs bind a ``{"kind": "job"}`` dict
+    to ``jb`` and a per-stage record to ``st`` before reading fields
+    (the span/rb/hb/al convention — ``st`` is reserved for stage
+    records in the three span-reader scripts), and workloads annotate
+    stages with literal names drawn from ``obs.trace.STAGE_VOCAB``.
+    Ad-hoc user stage names stay legal at runtime; the vocabulary only
+    pins what ships in-tree so ``shuffle_report --doctor`` advice keys
+    can never dangle."""
+    trace_sf = ctx.file("sparkrdma_tpu/obs/trace.py")
+    if trace_sf is None:
+        return []
+    findings = []
+    checks = []
+    for set_name, pattern, what in (
+            ("JOB_FIELDS", JOB_GET, "job"),
+            ("STAGE_FIELDS", STAGE_GET, "stage")):
+        fields = _frozen_field_set(trace_sf, set_name)
+        if fields is None:
+            findings.append(Finding(
+                "trace-schema-sync", trace_sf.rel, 0,
+                f"obs/trace.py must declare {set_name} as a literal "
+                "frozenset of strings", obj="sparkrdma_tpu"))
+            continue
+        checks.append((pattern, fields, what, f"obs.trace.{set_name}"))
+
+    # (a) every CLI read of a job/stage field exists on the schema
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            for pattern, allowed, what, where in checks:
+                for m in pattern.finditer(line):
+                    if m.group(1) not in allowed:
+                        findings.append(Finding(
+                            "trace-schema-sync", sf.rel, lineno,
+                            f"scripts/{script} reads {what} field "
+                            f"{m.group(1)!r} which does not exist in "
+                            f"{where} — rename the field or fix the "
+                            "script", obj="scripts"))
+
+    vocab = _frozen_field_set(trace_sf, "STAGE_VOCAB")
+    if vocab is None:
+        findings.append(Finding(
+            "trace-schema-sync", trace_sf.rel, 0,
+            "obs/trace.py must declare STAGE_VOCAB as a literal "
+            "frozenset of strings", obj="sparkrdma_tpu"))
+        return findings
+
+    # (b) shuffle_report's stage-advice table keys on declared stages
+    # only — an advice key outside the vocabulary can never match a
+    # shipped workload and would silently never fire
+    report_sf = ctx.file("scripts/shuffle_report.py")
+    if report_sf is not None:
+        advice = _dict_literal_keys(report_sf, "STAGE_ADVICE")
+        if advice is not None:
+            keys, lineno = advice
+            for extra in sorted(keys - vocab):
+                findings.append(Finding(
+                    "trace-schema-sync", report_sf.rel, lineno,
+                    f"STAGE_ADVICE keys on stage {extra!r} which is "
+                    "not in obs.trace.STAGE_VOCAB — add the stage to "
+                    "the vocabulary or drop the advice row",
+                    obj="scripts"))
+
+    # (c) in-tree stage annotations use the declared vocabulary
+    for sf in ctx.package_files():
+        if sf.rel == trace_sf.rel:
+            continue   # the declaring module, not an annotation site
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in STAGE_CALL.finditer(line):
+                if m.group(1) not in vocab:
+                    findings.append(Finding(
+                        "trace-schema-sync", sf.rel, lineno,
+                        f"{sf.rel} annotates stage {m.group(1)!r} "
+                        "which is not in obs.trace.STAGE_VOCAB — "
+                        "register the name so report/doctor advice "
+                        "and lint stay in sync", obj="sparkrdma_tpu"))
+    return findings

@@ -169,6 +169,29 @@ def enrich(span, metrics=None):
     return span
 
 
+def partition_to_wall(phase_s: Dict[str, float],
+                      wall_s: float) -> Dict[str, float]:
+    """Scale/pad a merged phase dict so it partitions ``wall_s`` exactly
+    — the same contract :func:`attribute` gives a single span, lifted
+    to aggregates (a job stage's spans sum to less host-attributed time
+    than the stage wall; the shortfall is charged to ``other``, an
+    overshoot — overlapping reads — is scaled down proportionally).
+    Returns ``{}`` when ``wall_s`` is not positive."""
+    wall_s = max(float(wall_s), 0.0)
+    if wall_s <= 0:
+        return {}
+    out = {p: float(v or 0.0) for p, v in phase_s.items()
+           if p in PHASES and v}
+    total = sum(out.values())
+    if total > wall_s:
+        scale = wall_s / total
+        out = {p: s * scale for p, s in out.items()}
+        total = wall_s
+    out = {p: round(s, 6) for p, s in out.items() if s > 0}
+    out["other"] = round(max(wall_s - total, 0.0), 6)
+    return out
+
+
 # ---------------------------------------------------------------------
 # cross-host merge (multi-journal; report-side)
 # ---------------------------------------------------------------------
@@ -225,5 +248,5 @@ def shuffle_verdict(spans: List) -> str:
 
 
 __all__ = ["PHASES", "VERDICTS", "PHASE_OF", "STRAGGLER_RATIO",
-           "attribute", "verdict", "enrich", "merge_phases",
-           "straggler_delta", "shuffle_verdict"]
+           "attribute", "verdict", "enrich", "partition_to_wall",
+           "merge_phases", "straggler_delta", "shuffle_verdict"]

@@ -51,7 +51,12 @@ Besides spans, a journal may carry **auxiliary lines** tagged with a
   host is distinguishable from an idle one;
 - ``{"kind": "alert", ...}`` — alert lifecycle records (fired /
   resolved) from :mod:`sparkrdma_tpu.obs.alerts`, the rule engine's
-  durable evidence trail consumed by ``shuffle_report --doctor``.
+  durable evidence trail consumed by ``shuffle_report --doctor``;
+- ``{"kind": "job", ...}`` — per-job trace summaries (schema v12) from
+  :mod:`sparkrdma_tpu.obs.trace`: per-stage critical-path profiles,
+  ``stage:idle`` time, the per-job verdict — consumed by
+  ``shuffle_report --jobs``, ``shuffle_top`` and the probe's ``/jobs``
+  route.
 
 :func:`read_journal` returns spans only; :func:`read_entries` returns
 everything.
@@ -121,7 +126,15 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: unchanged from v10, so v10↔v11 interchange is pure kind-tolerance:
 #: a v10 reader skips the unknown kind, a v11 reader reads v10 lines
 #: verbatim (pinned by tests/test_alerts.py).
-SCHEMA_VERSION = 11
+#: v12: + ``trace_id``/``job``/``stage``/``stage_attempt`` — job-trace
+#: coordinates (obs/trace.py TraceContext) stamped onto spans, rollup
+#: windows, heartbeats and admission lines when a job is being traced
+#: ("" / 0 outside any job context), + auxiliary ``{"kind": "job"}``
+#: summary lines (obs/trace.py JOB_FIELDS — per-stage critical-path
+#: profiles, stage:idle, the per-job verdict). v11↔v12 interchange is
+#: the usual drop-unknown/default-missing contract, pinned both
+#: directions by tests/test_trace.py.
+SCHEMA_VERSION = 12
 
 
 @dataclasses.dataclass
@@ -200,6 +213,12 @@ class ExchangeSpan:
     # span's wall-clock) and the derived bottleneck verdict ---
     phase_s: Dict[str, float] = dataclasses.field(default_factory=dict)
     bottleneck: str = ""
+    # --- job-trace coordinates (schema v12) — stamped from the active
+    # obs/trace.py JobTrace; the defaults mean "outside any job" ---
+    trace_id: str = ""
+    job: str = ""
+    stage: str = ""
+    stage_attempt: int = 0
     ts: float = dataclasses.field(default_factory=time.time)
     schema: int = SCHEMA_VERSION
 

@@ -18,6 +18,16 @@ paths:
 - ``/journal``  — JSON array of this process's journal entries (all
   rotated segments), exactly what the file-based CLIs read; this is
   what makes ``shuffle_top --connect`` render byte-identical tables.
+  The body is **streamed entry-by-entry** (one array element per line)
+  rather than materialized, so a long-running daemon's probe stays
+  bounded-memory however large the journal grows — the wire payload is
+  still one valid JSON array.
+- ``/jobs``     — recent ``{"kind": "job"}`` trace summaries
+  (obs/trace.py JOB_FIELDS lines): ``{"served_at_s", "uptime_s",
+  "jobs": [...]}``, newest last. Served from the TelemetryStore's
+  per-job history rings when wired, else recovered by scanning the
+  journal — so the route works for daemons and standalone managers
+  alike.
 - ``/snapshot`` — JSON object: heartbeat identity, TelemetryStore
   state (:meth:`~sparkrdma_tpu.obs.tsdb.TelemetryStore.stats`), live
   (open-window) rollup cells, per-tenant usage.
@@ -105,7 +115,8 @@ class ProbeServer:
                  rollups: Optional[Callable[[], List[Dict]]] = None,
                  tenants: Optional[Callable[[], Dict]] = None,
                  alerts: Optional[Callable[[], List[Dict]]] = None,
-                 health: Optional[Callable[[], Dict]] = None):
+                 health: Optional[Callable[[], Dict]] = None,
+                 jobs: Optional[Callable[[], List[Dict]]] = None):
         self._metrics = metrics
         self._telemetry = telemetry
         self._identity = dict(identity or {})
@@ -114,6 +125,7 @@ class ProbeServer:
         self._tenants = tenants
         self._alerts = alerts
         self._health = health
+        self._jobs = jobs
         self._started_mono = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -186,8 +198,36 @@ class ProbeServer:
             line = line[4:].strip()
         if self._metrics is not None:
             self._metrics.counter("probe.requests").inc()
-        body = self._route(line or "/snapshot")
+        path = line or "/snapshot"
+        if path == "/journal":
+            # bounded-memory path: the journal can be arbitrarily large,
+            # so entries stream one line at a time instead of being
+            # materialized (plus rotated segments) as one string
+            self._stream_journal(conn)
+            return
+        body = self._route(path)
         conn.sendall(body.encode("utf-8"))
+
+    def _stream_journal(self, conn: socket.socket) -> None:
+        """Stream ``/journal`` entry-by-entry as ONE valid JSON array
+        (``shuffle_top --connect`` json.loads the whole body), holding
+        at most one entry in memory at a time."""
+        from sparkrdma_tpu.obs.journal import iter_entries
+        conn.sendall(b"[")
+        first = True
+        if self._journal_path:
+            try:
+                for entry in iter_entries(self._journal_path,
+                                          include_rotated=True):
+                    sep = b"\n" if first else b",\n"
+                    conn.sendall(sep + json.dumps(
+                        entry, separators=(",", ":")).encode("utf-8"))
+                    first = False
+            except OSError:
+                # the journal sink is lazy — no file until the first
+                # emit; an empty process legitimately serves []
+                pass
+        conn.sendall(b"]" if first else b"\n]")
 
     def _route(self, path: str) -> str:
         if path == "/journal":
@@ -206,8 +246,11 @@ class ProbeServer:
                       else {"status": "ok", "score": 100, "active": 0,
                             "subsystems": {}})
             return json.dumps(dict(self._staleness(), **health))
+        if path == "/jobs":
+            return json.dumps(dict(self._staleness(),
+                                   jobs=self._job_lines()))
         return json.dumps({"error": f"unknown path {path!r}",
-                           "paths": ["/journal", "/snapshot",
+                           "paths": ["/journal", "/jobs", "/snapshot",
                                      "/metrics", "/alerts",
                                      "/health"]})
 
@@ -222,6 +265,25 @@ class ProbeServer:
         except OSError:
             # the journal sink is lazy — no file until the first emit;
             # an empty process legitimately serves an empty array
+            return []
+
+    def _job_lines(self) -> List[Dict]:
+        """Recent job-trace summaries: the wired ``jobs`` source (the
+        TelemetryStore's per-job rings) when it has any, else a journal
+        scan — a standalone manager with telemetry off still serves its
+        closed jobs."""
+        if self._jobs is not None:
+            lines = list(self._jobs())
+            if lines:
+                return lines
+        if not self._journal_path:
+            return []
+        from sparkrdma_tpu.obs.journal import iter_entries
+        try:
+            return [e for e in iter_entries(self._journal_path,
+                                            include_rotated=True)
+                    if e.get("kind") == "job"]
+        except OSError:
             return []
 
     def _staleness(self) -> Dict:

@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional
 
 from sparkrdma_tpu.obs.journal import SCHEMA_VERSION, ExchangeSpan
 from sparkrdma_tpu.obs.metrics import bucket_quantile
+from sparkrdma_tpu.obs.trace import current_trace
 
 log = logging.getLogger("sparkrdma_tpu.rollup")
 
@@ -51,6 +52,7 @@ LATENCY_BOUNDS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 #: every key a ``{"kind": "rollup"}`` line carries (lint-pinned)
 ROLLUP_FIELDS = frozenset({
     "kind", "schema", "ts", "process_index", "shuffle_id", "tenant",
+    "trace_id", "job", "stage", "stage_attempt",
     "window_start", "window_s",
     "reads", "sampled_reads", "records", "bytes", "rounds", "dispatches",
     "retries", "spills", "streaming_reads", "fused_reads",
@@ -67,6 +69,7 @@ HEARTBEAT_FIELDS = frozenset({
     "kind", "schema", "ts", "seq", "process_index", "host_count", "host",
     "pid", "uptime_s", "in_flight", "pool_outstanding", "spans_emitted",
     "rotations", "rss_mb", "host_tier_mb", "disk_tier_mb", "tenants",
+    "trace_id", "job", "stage", "stage_attempt",
 })
 
 
@@ -164,7 +167,11 @@ class RollupAggregator:
             b += 1
         with self._lock:
             pending = self._roll_locked(now)
-            ckey = (span.tenant, span.shuffle_id)
+            # one cell per tenant per shuffle per trace stage: a window
+            # spanning a stage boundary splits into per-stage lines, so
+            # the job layer's stage attribution stays exact
+            ckey = (span.tenant, span.shuffle_id, span.trace_id,
+                    span.job, span.stage, span.stage_attempt)
             cell = self._cells.get(ckey)
             if cell is None:
                 cell = self._cells[ckey] = _Cell()
@@ -232,13 +239,16 @@ class RollupAggregator:
             return [{
                 "tenant": tenant,
                 "shuffle_id": sid,
+                "job": job,
+                "stage": stg,
                 "window_start": start,
                 "reads": c.reads,
                 "records": c.records,
                 "bytes": c.bytes,
                 "retries": c.retries,
                 "spills": c.spills,
-            } for (tenant, sid), c in sorted(self._cells.items())]
+            } for (tenant, sid, _tid, job, stg, _att), c
+                in sorted(self._cells.items())]
 
     def _roll_locked(self, now: float) -> List[Dict]:
         """Advance the window; returns drained lines to emit once the
@@ -260,8 +270,9 @@ class RollupAggregator:
         lines *outside* ``_lock`` so slow journal I/O never extends the
         aggregator's critical section."""
         pending: List[Dict] = []
-        for tenant, sid in sorted(self._cells):
-            c = self._cells[(tenant, sid)]
+        for ckey in sorted(self._cells):
+            tenant, sid, trace_id, job, stg, attempt = ckey
+            c = self._cells[ckey]
             d = {
                 "kind": "rollup",
                 "schema": SCHEMA_VERSION,
@@ -269,6 +280,10 @@ class RollupAggregator:
                 "process_index": self.process_index,
                 "shuffle_id": sid,
                 "tenant": tenant,
+                "trace_id": trace_id,
+                "job": job,
+                "stage": stg,
+                "stage_attempt": attempt,
                 "window_start": self._window_start,
                 "window_s": self.window_s,
                 "reads": c.reads,
@@ -409,6 +424,7 @@ class HeartbeatEmitter:
                 self.seq += 1
                 seq = self.seq
                 self._last_beat_at = now
+            tctx = current_trace()
             d = {
                 "kind": "heartbeat",
                 "schema": SCHEMA_VERSION,
@@ -429,6 +445,13 @@ class HeartbeatEmitter:
                 "disk_tier_mb": self._probe("disk_tier_mb"),
                 # tenant -> per-tier usage (empty outside the service)
                 "tenants": self._probe_raw("tenants"),
+                # job-trace coordinates (schema v12) of whatever job is
+                # active at beat time — the liveness line says what the
+                # process was *doing*, not just that it is alive
+                "trace_id": tctx.trace_id if tctx else "",
+                "job": tctx.job if tctx else "",
+                "stage": tctx.stage if tctx else "",
+                "stage_attempt": tctx.stage_attempt if tctx else 0,
             }
             if set(d) != HEARTBEAT_FIELDS:
                 # must survive python -O; caught + counted just below

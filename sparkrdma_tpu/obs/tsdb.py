@@ -105,6 +105,8 @@ class TelemetryStore:
         self._samples: deque = deque(maxlen=history)   # guarded-by: _lock
         # (tenant, shuffle_id) -> ring of emitted rollup lines
         self._rollups: Dict[Tuple[str, int], deque] = {}  # guarded-by: _lock
+        # (tenant, job) -> ring of emitted {"kind": "job"} lines
+        self._jobs: Dict[Tuple[str, str], deque] = {}     # guarded-by: _lock
         self.evicted = 0                               # guarded-by: _lock
         self.sample_errors = 0                         # guarded-by: _lock
         self._stop = threading.Event()
@@ -167,6 +169,17 @@ class TelemetryStore:
             ring = self._rollups.get(key)
             if ring is None:
                 ring = self._rollups[key] = deque(maxlen=self.history)
+            ring.append(line)
+
+    def observe_job(self, line: Dict) -> None:
+        """Record one emitted ``{"kind": "job"}`` summary line into the
+        per-job history ring (called by obs/trace.py at job close)."""
+        key = (str(line.get("tenant", "") or ""),
+               str(line.get("job", "") or ""))
+        with self._lock:
+            ring = self._jobs.get(key)
+            if ring is None:
+                ring = self._jobs[key] = deque(maxlen=self.history)
             ring.append(line)
 
     # -- queries ------------------------------------------------------
@@ -232,12 +245,31 @@ class TelemetryStore:
             ring = self._rollups.get((tenant, int(shuffle_id)))
             return list(ring) if ring is not None else []
 
+    def job_history(self, job: str, tenant: str = "") -> List[Dict]:
+        """The retained ``{"kind": "job"}`` lines of one (tenant, job)
+        name, oldest first (empty when the job never closed here)."""
+        with self._lock:
+            ring = self._jobs.get((tenant, str(job)))
+            return list(ring) if ring is not None else []
+
+    def job_lines(self, limit: int = 0) -> List[Dict]:
+        """Every retained job line across all rings, oldest first by
+        close timestamp (the probe's ``/jobs`` payload); ``limit`` > 0
+        keeps only the newest N."""
+        with self._lock:
+            lines = [ln for ring in self._jobs.values() for ln in ring]
+        lines.sort(key=lambda ln: ln.get("ts", 0.0))
+        if limit > 0:
+            lines = lines[-limit:]
+        return lines
+
     def stats(self) -> Dict:
         """JSON-ready snapshot for the probe endpoint: ring state, the
         newest sample, and full-ring per-second rates per series."""
         with self._lock:
             samples = list(self._samples)
             rollup_keys = sorted(self._rollups)
+            job_keys = sorted(self._jobs)
             evicted = self.evicted
         newest: Dict = samples[-1][1] if samples else {}
         rates: Dict[str, float] = {}
@@ -257,6 +289,7 @@ class TelemetryStore:
             "last": dict(newest),
             "rate": rates,
             "rollup_series": [f"{t}/{sid}" for t, sid in rollup_keys],
+            "job_series": [f"{t}/{j}" for t, j in job_keys],
         }
 
     # -- lifecycle ----------------------------------------------------
@@ -301,6 +334,15 @@ class _NullTelemetryStore(TelemetryStore):
         return ZERO_WINDOWED
 
     def rollup_history(self, shuffle_id: int, tenant: str = ""):
+        return _EMPTY_TUPLE
+
+    def observe_job(self, line: Dict) -> None:
+        pass
+
+    def job_history(self, job: str, tenant: str = ""):
+        return _EMPTY_TUPLE
+
+    def job_lines(self, limit: int = 0):
         return _EMPTY_TUPLE
 
     def stats(self) -> Dict:

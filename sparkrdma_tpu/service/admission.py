@@ -177,13 +177,22 @@ class AdmissionController:
         if self.metrics is not None:
             self.metrics.counter("service.admission_waits").inc()
         from sparkrdma_tpu.obs.timeline import record_active
+        from sparkrdma_tpu.obs.trace import current_trace
 
         record_active("admission:wait", tenant=tenant, cost=cost,
                       ms=round(waited_s * 1e3, 3))
         if self.journal is not None and self.journal.enabled:
+            # schema v12: admission waits carry the job-trace
+            # coordinates of the read they delayed, so a job's verdict
+            # can point at quota pressure, not just data-path phases
+            tctx = current_trace()
             self.journal.emit_raw({
                 "kind": "admission", "event": "wait", "tenant": tenant,
                 "cost": cost, "wait_ms": round(waited_s * 1e3, 3),
+                "trace_id": tctx.trace_id if tctx else "",
+                "job": tctx.job if tctx else "",
+                "stage": tctx.stage if tctx else "",
+                "stage_attempt": tctx.stage_attempt if tctx else 0,
                 "ts": time.time()})
 
     def stats(self) -> dict:

@@ -160,7 +160,8 @@ class ShuffleService:
                     alerts=(self.alerts.active
                             if self.alerts is not None else None),
                     health=(self.alerts.health
-                            if self.alerts is not None else None))
+                            if self.alerts is not None else None),
+                    jobs=self.telemetry.job_lines)
                 self.probe.start()
             except OSError:
                 # the probe must never take the daemon down with it
@@ -260,7 +261,19 @@ class ShuffleService:
             "sessions": open_sessions,
             "admission": self.admission.stats(),
             "store": self.tiered.occupancy_by_tenant(),
+            # per-tenant job traces closed against the shared telemetry
+            # store (tenant sessions pass it to their JobTraces), newest
+            # last — the daemon-side mirror of the probe's /jobs route
+            "jobs": self.jobs_by_tenant(),
         }
+
+    def jobs_by_tenant(self) -> Dict[str, List[Dict]]:
+        """Retained ``{"kind": "job"}`` lines grouped per tenant."""
+        out: Dict[str, List[Dict]] = {}
+        for line in self.telemetry.job_lines():
+            out.setdefault(str(line.get("tenant", "") or ""),
+                           []).append(line)
+        return out
 
     # --- lifecycle ------------------------------------------------------
     def stop(self) -> None:

@@ -48,6 +48,7 @@ from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
+from sparkrdma_tpu.obs import trace as _trace
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.stats import barrier
 
@@ -242,19 +243,30 @@ def run_als(
     U = runtime.shard_rows(np.zeros((mesh * uper, k), np.float32))
 
     t0 = time.perf_counter()
-    for _ in range(iterations):
-        # user half-step: shuffle item-side partial sums to user owners
-        rec = build_fn(V, ubase, usrc, urate, umask_g)
-        out, totals, _ = ex.exchange(rec, part, uplan, mesh,
-                                     aggregator="sum", float_payload=True)
-        U = user_update(out, totals)
-        # item half-step: shuffle user-side partial sums to item owners
-        rec = build_fn(U, ibase, isrc, irate, imask_g)
-        out, totals, _ = ex.exchange(rec, part, iplan, mesh,
-                                     aggregator="sum", float_payload=True)
-        # Stage barrier per half-iteration pair (see pagerank.py note).
-        V = item_update(out, totals)
-        barrier(V)
+    for it in range(iterations):
+        # Each ALS half-step is one job-trace stage (attempt = iteration
+        # index; a no-op outside ``manager.job(...)`` — this path runs a
+        # journal-less ShuffleExchange so stage wall-clocks come from the
+        # JobTrace clock, not spans).
+        with _trace.stage("update_users", attempt=it):
+            # user half-step: shuffle item-side partial sums to user
+            # owners
+            rec = build_fn(V, ubase, usrc, urate, umask_g)
+            out, totals, _ = ex.exchange(rec, part, uplan, mesh,
+                                         aggregator="sum",
+                                         float_payload=True)
+            U = user_update(out, totals)
+        with _trace.stage("update_items", attempt=it):
+            # item half-step: shuffle user-side partial sums to item
+            # owners
+            rec = build_fn(U, ibase, isrc, irate, imask_g)
+            out, totals, _ = ex.exchange(rec, part, iplan, mesh,
+                                         aggregator="sum",
+                                         float_payload=True)
+            # Stage barrier per half-iteration pair (see pagerank.py
+            # note).
+            V = item_update(out, totals)
+            barrier(V)
     total_s = time.perf_counter() - t0
 
     u_np = _from_owner_layout(np.asarray(U), mesh, num_users)

@@ -41,6 +41,7 @@ from sparkrdma_tpu.utils.compat import shard_map
 
 from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
 from sparkrdma_tpu.exchange.protocol import ShuffleExchange
+from sparkrdma_tpu.obs import trace as _trace
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.utils.stats import barrier
 
@@ -181,18 +182,25 @@ def run_pagerank(
 
     t0 = time.perf_counter()
     ranks = ranks_owner
-    for _ in range(iterations):
-        records = build_fn(ranks, base_global, src_idx, emask_global,
-                           outdeg_owner)
-        out, totals, _ = ex.exchange(records, part, plan, mesh,
-                                     aggregator="sum", float_payload=True)
-        ranks = update_fn(out, totals, outdeg_owner)
-        # Per-iteration barrier: each shuffle iteration is a Spark stage
-        # boundary (BSP). Also keeps the async dispatch queue shallow —
-        # on forced-host CPU meshes, piling up collective programs can
-        # starve XLA's single-core rendezvous scheduler — and makes the
-        # timing honest on backends where block_until_ready is unreliable.
-        barrier(ranks)
+    for it in range(iterations):
+        # job tracing: each BSP iteration is one "rank_update" stage,
+        # attempt = iteration index (no-op outside ``manager.job(...)``;
+        # this path runs a journal-less ShuffleExchange, so stage
+        # wall-clocks come from the JobTrace clock, not spans)
+        with _trace.stage("rank_update", attempt=it):
+            records = build_fn(ranks, base_global, src_idx, emask_global,
+                               outdeg_owner)
+            out, totals, _ = ex.exchange(records, part, plan, mesh,
+                                         aggregator="sum",
+                                         float_payload=True)
+            ranks = update_fn(out, totals, outdeg_owner)
+            # Per-iteration barrier: each shuffle iteration is a Spark
+            # stage boundary (BSP). Also keeps the async dispatch queue
+            # shallow — on forced-host CPU meshes, piling up collective
+            # programs can starve XLA's single-core rendezvous scheduler
+            # — and makes the timing honest on backends where
+            # block_until_ready is unreliable.
+            barrier(ranks)
     total_s = time.perf_counter() - t0
 
     # owner layout [mesh*vper] -> dense [v]

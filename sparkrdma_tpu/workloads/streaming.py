@@ -41,6 +41,7 @@ from sparkrdma_tpu.hbm.host_staging import SpillWriter
 from sparkrdma_tpu.hbm.input_stream import InputStreamer, StoreChunkSource
 from sparkrdma_tpu.hbm.tiered_store import store_totals
 from sparkrdma_tpu.meta.sampling import compute_splitters
+from sparkrdma_tpu.obs import trace as _trace
 from sparkrdma_tpu.utils.stats import barrier
 
 
@@ -254,6 +255,11 @@ def run_tiered_terasort(
 
     base0 = store_totals()
     t0 = time.perf_counter()
+    # job tracing: publication, per-chunk exchanges, and the host-side
+    # collect are the three stages of this workload (no-ops outside
+    # ``manager.job(...)``)
+    _pub = _trace.stage("publish")
+    _pub.__enter__()
     if resume:
         manager.resume_segments(shuffle_id_base)
     else:
@@ -297,6 +303,8 @@ def run_tiered_terasort(
     part = range_partitioner(splitters, kw)
     del first
 
+    _pub.__exit__(None, None, None)
+
     src = StoreChunkSource(store, keys,
                            lookahead=manager.conf.spill_tier_prefetch)
     streamer = InputStreamer(rt, src)
@@ -310,23 +318,25 @@ def run_tiered_terasort(
         handle = manager.register_shuffle(shuffle_id_base + 1 + j, mesh,
                                           part)
         try:
-            manager.get_writer(handle).write(chunk).stop(True)
-            # record_stats=True: each chunk's span carries the store's
-            # cumulative spill/fetch counters and its spill:* timeline
-            # events — the journal evidence that tier I/O overlapped the
-            # exchange rounds (and the --doctor input)
-            out, totals = manager.get_reader(
-                handle, key_ordering=True).read()
-            if collect:
-                host = np.asarray(out)
-                tot = np.asarray(totals)
-                cap = host.shape[1] // mesh
-                for d in range(mesh):
-                    k = int(tot[d])
-                    device_rows[d].append(
-                        np.array(host[:, d * cap:d * cap + k].T))
-            else:
-                barrier(out)
+            with _trace.stage("chunk_sort", attempt=j):
+                manager.get_writer(handle).write(chunk).stop(True)
+                # record_stats=True: each chunk's span carries the
+                # store's cumulative spill/fetch counters and its
+                # spill:* timeline events — the journal evidence that
+                # tier I/O overlapped the exchange rounds (and the
+                # --doctor input)
+                out, totals = manager.get_reader(
+                    handle, key_ordering=True).read()
+                if collect:
+                    host = np.asarray(out)
+                    tot = np.asarray(totals)
+                    cap = host.shape[1] // mesh
+                    for d in range(mesh):
+                        k = int(tot[d])
+                        device_rows[d].append(
+                            np.array(host[:, d * cap:d * cap + k].T))
+                else:
+                    barrier(out)
         finally:
             manager.unregister_shuffle(shuffle_id_base + 1 + j)
             # round k's consumed chunk leaves the store; the background
@@ -336,9 +346,10 @@ def run_tiered_terasort(
 
     rows = None
     if collect:
-        rows = _canon(np.concatenate(
-            [r for per_dev in device_rows for r in per_dev])
-            if records else np.zeros((0, w), np.uint32))
+        with _trace.stage("collect"):
+            rows = _canon(np.concatenate(
+                [r for per_dev in device_rows for r in per_dev])
+                if records else np.zeros((0, w), np.uint32))
     return TieredSortResult(
         chunks=n_chunks, records=records, record_bytes=4 * w,
         stream_s=stream_s, rows=rows,

@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
 from sparkrdma_tpu.exchange.partitioners import hash_partitioner
+from sparkrdma_tpu.obs import trace as _trace
 from sparkrdma_tpu.utils.compat import shard_map
 from sparkrdma_tpu.utils.stats import barrier
 
@@ -177,27 +178,32 @@ def run_q64_shape(
         return handle, out, totals, writer.plan.out_capacity
 
     # exchange 1: fact + item by item_key ------------------------------
-    _, f1, tf1, capf1 = co_partition(sids[0], rt.shard_records(fact))
-    _, d1, td1, capd1 = co_partition(sids[1], rt.shard_records(item))
-    enriched = _lookup(manager, capf1, capd1, False, 0)(f1, tf1, d1, td1)
-    manager.unregister_shuffle(sids[0])
-    manager.unregister_shuffle(sids[1])
+    # (job-trace stage scopes are no-ops outside ``manager.job(...)``)
+    with _trace.stage("item_join"):
+        _, f1, tf1, capf1 = co_partition(sids[0], rt.shard_records(fact))
+        _, d1, td1, capd1 = co_partition(sids[1], rt.shard_records(item))
+        enriched = _lookup(manager, capf1, capd1, False, 0)(f1, tf1,
+                                                            d1, td1)
+        manager.unregister_shuffle(sids[0])
+        manager.unregister_shuffle(sids[1])
 
     # exchange 2: enriched fact + store by store_key -------------------
-    _, f2, tf2, capf2 = co_partition(sids[2], enriched)
-    _, d2, td2, capd2 = co_partition(sids[3], rt.shard_records(store))
-    filtered = _lookup(manager, capf2, capd2, True,
-                       region_cutoff)(f2, tf2, d2, td2)
-    manager.unregister_shuffle(sids[2])
-    manager.unregister_shuffle(sids[3])
+    with _trace.stage("store_join"):
+        _, f2, tf2, capf2 = co_partition(sids[2], enriched)
+        _, d2, td2, capd2 = co_partition(sids[3], rt.shard_records(store))
+        filtered = _lookup(manager, capf2, capd2, True,
+                           region_cutoff)(f2, tf2, d2, td2)
+        manager.unregister_shuffle(sids[2])
+        manager.unregister_shuffle(sids[3])
 
     # exchange 3: group by category, fused sum aggregation -------------
-    handle = manager.register_shuffle(sids[4], mesh, part)
-    writer = manager.get_writer(handle).write(filtered)
-    writer.stop(True)
-    gout, gtot = manager.get_reader(handle, aggregator="sum",
-                                    row_filter=_drop_null_key).read()
-    barrier(gout)
+    with _trace.stage("group_agg"):
+        handle = manager.register_shuffle(sids[4], mesh, part)
+        writer = manager.get_writer(handle).write(filtered)
+        writer.stop(True)
+        gout, gtot = manager.get_reader(handle, aggregator="sum",
+                                        row_filter=_drop_null_key).read()
+        barrier(gout)
     shuffle_s = time.perf_counter() - t0
 
     cap = writer.plan.out_capacity
@@ -290,12 +296,16 @@ def run_q95_shape(
     t0 = time.perf_counter()
 
     outs = []
-    for sid, table in zip(shuffle_ids, (sales, returns)):
-        handle = manager.register_shuffle(sid, mesh, part)
-        writer = manager.get_writer(handle).write(rt.shard_records(table))
-        writer.stop(True)
-        out, totals = manager.get_reader(handle).read(record_stats=False)
-        outs.append((out, totals, writer.plan.out_capacity))
+    # stage 1 under ``manager.job(...)``: both co-partition exchanges
+    with _trace.stage("co_partition"):
+        for sid, table in zip(shuffle_ids, (sales, returns)):
+            handle = manager.register_shuffle(sid, mesh, part)
+            writer = manager.get_writer(handle).write(
+                rt.shard_records(table))
+            writer.stop(True)
+            out, totals = manager.get_reader(handle).read(
+                record_stats=False)
+            outs.append((out, totals, writer.plan.out_capacity))
 
     (so, st, sc), (ro, rtot, rc) = outs
     ax = rt.axis_name
@@ -326,19 +336,21 @@ def run_q95_shape(
     barrier(ro)   # ro is dispatched last: syncing it covers BOTH exchanges
     shuffle_s = time.perf_counter() - t0   # exchanges only, not compile
 
-    cache = _lookup_cache.setdefault(manager, {})
-    ckey = ("q95", sc, rc)
-    fn = cache.get(ckey)
-    if fn is None:
-        fn = jax.jit(shard_map(
-            local, mesh=rt.mesh,
-            in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
-            out_specs=(P(ax), P(ax)),
-        ))
-        cache[ckey] = fn
-    cnt, net = fn(so, st, ro, rtot)
-    count = int(np.asarray(cnt)[0])
-    net_sum = float(np.asarray(net)[0])
+    # stage 2: the semi/anti probe join over co-partitioned tables
+    with _trace.stage("probe_join"):
+        cache = _lookup_cache.setdefault(manager, {})
+        ckey = ("q95", sc, rc)
+        fn = cache.get(ckey)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                local, mesh=rt.mesh,
+                in_specs=(P(None, ax), P(ax), P(None, ax), P(ax)),
+                out_specs=(P(ax), P(ax)),
+            ))
+            cache[ckey] = fn
+        cnt, net = fn(so, st, ro, rtot)
+        count = int(np.asarray(cnt)[0])
+        net_sum = float(np.asarray(net)[0])
     for sid in shuffle_ids:
         manager.unregister_shuffle(sid)
 

@@ -332,6 +332,89 @@ def test_alert_rule_sync_nonliteral_metrics_skipped(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# trace schema sync
+# ---------------------------------------------------------------------
+
+_TRACE = """
+    JOB_FIELDS = frozenset({"kind", "ts", "trace_id", "job", "wall_s",
+                            "dominant_stage", "stages"})
+    STAGE_FIELDS = frozenset({"stage", "attempt", "wall_s", "spans"})
+    STAGE_VOCAB = frozenset({"probe_join", "rank_update"})
+"""
+
+
+def test_trace_schema_sync_clean(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/trace.py": _TRACE,
+        "sparkrdma_tpu/workloads/w.py": """
+            def run(_trace):
+                with _trace.stage("probe_join"):
+                    pass
+        """,
+        # single quotes inside an f-string are the common job-reader
+        # shape — the rule must accept both quote styles
+        "scripts/shuffle_report.py": """
+            STAGE_ADVICE = {"probe_join": "shrink the build side"}
+
+            def render(jb):
+                out = [f"{jb.get('job')}: {jb.get('wall_s')}s"]
+                for st in jb.get("stages") or []:
+                    out.append((st.get("stage"), st.get("wall_s")))
+                return out
+        """,
+    })
+    assert run_rules(root, select=["trace-schema-sync"]) == []
+
+
+def test_trace_schema_sync_ghost_fields(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/trace.py": _TRACE,
+        "scripts/shuffle_top.py": """
+            def render(jb, st):
+                return (jb.get("ghost_job_field"), st.get("ghost_stage"))
+        """,
+    })
+    got = run_rules(root, select=["trace-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2
+    assert "ghost_job_field" in msgs and "ghost_stage" in msgs
+    assert "obs.trace.JOB_FIELDS" in msgs
+    assert "obs.trace.STAGE_FIELDS" in msgs
+    assert all(f.obj == "scripts" for f in got)
+
+
+def test_trace_schema_sync_advice_and_annotation_vocab(tmp_path):
+    # an advice row keyed on an unregistered stage AND a workload
+    # annotating an unregistered stage — both directions of the
+    # vocabulary pin fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/obs/trace.py": _TRACE,
+        "sparkrdma_tpu/workloads/w.py": """
+            def run(_trace):
+                with _trace.stage("mystery_stage"):
+                    pass
+        """,
+        "scripts/shuffle_report.py": """
+            STAGE_ADVICE = {"not_a_stage": "advice nothing can match"}
+        """,
+    })
+    got = run_rules(root, select=["trace-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2
+    assert "not_a_stage" in msgs and "mystery_stage" in msgs
+
+
+def test_trace_schema_sync_skips_without_trace_module(tmp_path):
+    root = repo(tmp_path, {
+        "scripts/shuffle_report.py": """
+            def render(jb):
+                return jb.get("anything_goes")
+        """,
+    })
+    assert run_rules(root, select=["trace-schema-sync"]) == []
+
+
+# ---------------------------------------------------------------------
 # timeline pairing
 # ---------------------------------------------------------------------
 
@@ -372,6 +455,41 @@ def test_timeline_pairing_nested_defs_are_separate_scopes(tmp_path):
     """})
     got = run_rules(root, select=["timeline-pairing"])
     assert len(got) == 1 and "'x'" in got[0].message
+
+
+def test_timeline_pairing_context_manager_methods_pair(tmp_path):
+    # the context-manager discipline: B in __enter__ / E in __exit__
+    # (and split _begin/_end helpers) pair across sibling methods of
+    # one class — but a class-wide open span still fires
+    root = repo(tmp_path, {"sparkrdma_tpu/t.py": """
+        class Scope:
+            def __enter__(self):
+                self.tl.begin("job")
+                return self
+
+            def __exit__(self, *exc):
+                self.tl.end("job")
+
+            def _begin_stage(self):
+                self.tl.begin("stage")
+
+            def _end_stage(self):
+                self.tl.end("stage")
+    """})
+    assert run_rules(root, select=["timeline-pairing"]) == []
+    (tmp_path / "sparkrdma_tpu/t.py").write_text(textwrap.dedent("""
+        class Leaky:
+            def __enter__(self):
+                self.tl.begin("job")
+                return self
+
+            def __exit__(self, *exc):
+                pass
+    """))
+    got = run_rules(root, select=["timeline-pairing"])
+    assert len(got) == 1
+    assert "'job'" in got[0].message
+    assert "sibling method of Leaky" in got[0].message
 
 
 # ---------------------------------------------------------------------
@@ -1425,7 +1543,7 @@ def test_real_repo_is_srlint_clean():
     every rule, zero findings (modulo in-source suppressions) — and the
     full run must fit the tier-1 preamble's wall-clock budget."""
     from sparkrdma_tpu.lint import all_rules
-    assert len(all_rules()) == 20, \
+    assert len(all_rules()) == 21, \
         "rule count drifted — update this pin, the README table, and " \
         "COVERAGE.md together"
     t0 = time.perf_counter()

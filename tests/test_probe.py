@@ -189,7 +189,7 @@ class TestRoutes:
             err = json.loads(fetch(srv.port, "GET /nope\n"))
         assert "unknown path" in err["error"]
         assert set(err["paths"]) == {"/journal", "/snapshot", "/metrics",
-                                     "/alerts", "/health"}
+                                     "/alerts", "/health", "/jobs"}
 
     def test_request_counter(self, tmp_path):
         reg, srv = make_server(tmp_path)

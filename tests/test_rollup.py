@@ -374,6 +374,8 @@ class TestSchemaV2V3:
             "pushdown_rows_dropped",           # v9: predicate/projection pushdown
             "pushdown_words_dropped",
             "phase_s", "bottleneck",           # v10: critical-path attribution
+            "trace_id", "job",                 # v12: job tracing
+            "stage", "stage_attempt",
         }
         v2_view = {k: v for k, v in d.items() if k in V2_FIELDS}
         span = ExchangeSpan.from_dict(v2_view)

@@ -1,0 +1,638 @@
+"""End-to-end job tracing (obs/trace.py) + the v11 <-> v12 journal
+interchange + the per-job operator surfaces.
+
+- scoping: thread-local overlay over the process-wide active job (the
+  fault-plane / timeline pattern) — heartbeat-style helper threads see
+  the global slot, a thread-scoped job wins on its own thread;
+- stage math under a fake clock: wall-clocks, the ``stage:idle`` gap,
+  span attribution routing, and the **partition invariant** (every
+  stage's ``phase_s`` sums to its wall; stage walls + idle partition
+  the job wall) — pinned at unit scale and again on a real CPU mesh;
+- schema pins: JOB_FIELDS/STAGE_FIELDS drift guards, v11 span lines
+  under the v12 reader and back;
+- the operator surfaces: TSDB per-job history rings, the probe
+  ``/jobs`` route, and golden CLI runs (``shuffle_report --jobs`` /
+  ``shuffle_top --once`` / ``shuffle_trace``) against the checked-in
+  multi-stage journal fixture — all agreeing with the journal line;
+- acceptance: ``run_q95_shape`` under ``manager.job(...)`` yields ONE
+  trace whose two stages agree across journal, report, Perfetto
+  export, and probe ``/jobs`` on stage count, per-stage wall-clock,
+  and dominant stage.
+"""
+
+import importlib.util
+import json
+import math
+import socket
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.exchange.partitioners import modulo_partitioner
+from sparkrdma_tpu.obs import critical_path as cp
+from sparkrdma_tpu.obs import trace
+from sparkrdma_tpu.obs.journal import (SCHEMA_VERSION, ExchangeSpan,
+                                       read_entries)
+from sparkrdma_tpu.obs.metrics import MetricsRegistry
+from sparkrdma_tpu.obs.probe import ProbeServer
+from sparkrdma_tpu.obs.tsdb import TelemetryStore
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "multistage_journal.jsonl"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", REPO / "scripts" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_scope():
+    """Every test starts and ends with no active job anywhere."""
+    trace.set_active_job(None)
+    trace._tls.job = None
+    yield
+    trace.set_active_job(None)
+    trace._tls.job = None
+
+
+def fetch(port: int, request: str, timeout: float = 5.0) -> bytes:
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(request.encode("utf-8"))
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return buf
+
+
+def make_clock(*ticks):
+    it = iter(ticks)
+    return lambda: next(it)
+
+
+def total(d):
+    return sum(d.values())
+
+
+# ---------------------------------------------------------------------
+# scoping
+# ---------------------------------------------------------------------
+
+class TestScoping:
+    def test_no_job_means_none_everywhere(self):
+        assert trace.active_job() is None
+        assert trace.current_trace() is None
+        trace.observe_active_span({"stage": "x"})   # no-op, no raise
+        with trace.stage("probe_join"):             # null scope
+            assert trace.current_trace() is None
+
+    def test_context_installs_global_and_tls(self):
+        jt = trace.JobTrace("j1")
+        with jt:
+            assert trace.active_job() is jt
+            tctx = trace.current_trace()
+            assert tctx.trace_id == jt.trace_id and tctx.job == "j1"
+        assert trace.active_job() is None
+        assert jt.line is not None          # closed on exit
+
+    def test_helper_thread_sees_global_slot(self):
+        """The heartbeat contract: a daemon thread with no thread-local
+        scope reads the process-wide active job."""
+        jt = trace.JobTrace("j_global")
+        seen = []
+        with jt:
+            t = threading.Thread(
+                target=lambda: seen.append(trace.active_job()))
+            t.start()
+            t.join()
+        assert seen == [jt]
+
+    def test_thread_local_overlay_wins(self):
+        """A thread-scoped job (tenant session) shadows the global one
+        on its own thread and ONLY there."""
+        g = trace.JobTrace("global_job")
+        s = trace.JobTrace("session_job")
+        with g:
+            with trace.scoped_job(s):
+                assert trace.active_job() is s
+            assert trace.active_job() is g
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(trace.active_job()))
+            t.start()
+            t.join()
+            assert seen == [g]   # other threads never saw the overlay
+
+    def test_scoped_job_none_is_passthrough(self):
+        g = trace.JobTrace("outer")
+        with g:
+            with trace.scoped_job(None):
+                assert trace.active_job() is g
+
+    def test_nested_jobs_restore(self):
+        a, b = trace.JobTrace("a"), trace.JobTrace("b")
+        with a:
+            with b:
+                assert trace.active_job() is b
+            assert trace.active_job() is a
+
+    def test_trace_ids_unique(self):
+        ids = {trace.next_trace_id() for _ in range(100)}
+        assert len(ids) == 100
+
+
+# ---------------------------------------------------------------------
+# stage math (fake clock)
+# ---------------------------------------------------------------------
+
+class TestStageMath:
+    def _span(self, stage, attempt=0, phase_s=None, bottleneck="",
+              records=100):
+        return {"stage": stage, "stage_attempt": attempt,
+                "phase_s": phase_s or {}, "bottleneck": bottleneck,
+                "records": records, "total_bytes": records * 16}
+
+    def test_stage_walls_idle_and_dominant(self):
+        jt = trace.JobTrace("j", clock=make_clock(
+            10.0, 11.0, 12.0, 14.5))   # s1: 1s, gap 1s, s2: 2.5s
+        with jt.stage("co_partition"):
+            pass
+        with jt.stage("probe_join"):
+            pass
+        line = jt.close(now=15.0)
+        assert line["wall_s"] == pytest.approx(5.0)
+        walls = {s["stage"]: s["wall_s"] for s in line["stages"]}
+        assert walls == {"co_partition": pytest.approx(1.0),
+                         "probe_join": pytest.approx(2.5)}
+        assert line["stage_idle_s"] == pytest.approx(1.5)
+        assert line["dominant_stage"] == "probe_join"
+        assert line["phase_s"][trace.STAGE_IDLE] == pytest.approx(1.5)
+
+    def test_partition_invariant_with_observed_spans(self):
+        """The pinned invariant: each stage's phase_s partitions its
+        own wall, and stage walls + stage_idle_s partition the job's —
+        so summing every stage phase plus idle reproduces wall_s."""
+        jt = trace.JobTrace("j", clock=make_clock(0.0, 2.0, 3.0, 7.0))
+        with jt.stage("co_partition"):
+            jt.observe_span(self._span(
+                "co_partition",
+                phase_s={"dispatch": 0.5, "decode": 0.25},
+                bottleneck="fabric-bound"))
+        with jt.stage("probe_join"):
+            jt.observe_span(self._span(
+                "probe_join", phase_s={"dispatch": 8.0, "fold": 4.0},
+                bottleneck="fabric-bound"))
+        line = jt.close(now=8.0)
+        for st in line["stages"]:
+            # under-observed stages pad into "other", over-observed
+            # scale down — either way the stage profile sums to wall
+            assert math.isclose(total(st["phase_s"]), st["wall_s"],
+                                rel_tol=1e-6, abs_tol=1e-4)
+        stage_phase_total = sum(total(st["phase_s"])
+                                for st in line["stages"])
+        assert math.isclose(stage_phase_total + line["stage_idle_s"],
+                            line["wall_s"], rel_tol=1e-6, abs_tol=1e-3)
+        # the merged job profile carries the same partition
+        assert math.isclose(total(line["phase_s"]), line["wall_s"],
+                            rel_tol=1e-6, abs_tol=1e-3)
+        # co_partition got padded (observed 0.75s of a 2s wall)
+        st0 = line["stages"][0]
+        assert st0["phase_s"]["other"] > 0
+        # probe_join got scaled (observed 12s of a 4s wall)
+        st1 = line["stages"][1]
+        assert total(st1["phase_s"]) == pytest.approx(4.0, abs=1e-4)
+
+    def test_span_routing_after_stage_close_and_votes(self):
+        jt = trace.JobTrace("j", clock=make_clock(0.0, 1.0, 1.0, 2.0))
+        with jt.stage("rank_update", attempt=0):
+            pass
+        with jt.stage("rank_update", attempt=1):
+            pass
+        # spans complete after their stages closed: routed by stamp
+        jt.observe_span(self._span("rank_update", attempt=0,
+                                   bottleneck="fabric-bound"))
+        jt.observe_span(self._span("rank_update", attempt=1,
+                                   bottleneck="codec-bound"))
+        jt.observe_span(self._span("rank_update", attempt=1,
+                                   bottleneck="codec-bound"))
+        jt.observe_span(self._span("not_a_stage"))      # dropped
+        line = jt.close(now=2.0)
+        by_attempt = {s["attempt"]: s for s in line["stages"]}
+        assert by_attempt[0]["spans"] == 1
+        assert by_attempt[0]["bottleneck"] == "fabric-bound"
+        assert by_attempt[1]["spans"] == 2
+        assert by_attempt[1]["bottleneck"] == "codec-bound"
+        assert line["spans"] == 3
+
+    def test_nested_stage_raises_mismatched_exit_tolerated(self):
+        jt = trace.JobTrace("j", clock=make_clock(0.0, 1.0, 2.0, 3.0))
+        scope = jt.stage("publish")
+        scope.__enter__()
+        with pytest.raises(RuntimeError, match="still open"):
+            jt._begin_stage("collect", 0)
+        jt._end_stage("collect", 0)       # wrong name: tolerated no-op
+        jt._end_stage("publish", 0)
+        assert jt.build_line(now=3.0)["stage_count"] == 1
+
+    def test_close_is_idempotent(self):
+        jt = trace.JobTrace("j", clock=make_clock(0.0))
+        first = jt.close(now=1.0)
+        assert jt.close(now=99.0) is first
+
+    def test_auto_stage_defers_to_explicit_scope(self):
+        jt = trace.JobTrace("j",
+                            clock=make_clock(0.0, 1.0, 2.0, 3.0, 4.0,
+                                             5.0))
+        with jt:
+            with trace.auto_stage("repartition"):     # opens a stage
+                pass
+            with jt.stage("group_agg"):
+                # library-layer annotation under an explicit stage:
+                # no-op, must NOT raise on nesting
+                with trace.auto_stage("repartition"):
+                    pass
+        names = [s["stage"] for s in jt.line["stages"]]
+        assert names == ["repartition", "group_agg"]
+
+
+# ---------------------------------------------------------------------
+# schema pins + v11 <-> v12 interchange
+# ---------------------------------------------------------------------
+
+#: the span fields only a schema-v12 line carries (v12 = v11 + the
+#: job-trace coordinates); pins the v11 <-> v12 interchange contract
+V12_ONLY_FIELDS = ("trace_id", "job", "stage", "stage_attempt")
+
+
+class TestSchemaV12:
+    def _make(self, **kw):
+        base = dict(span_id=1, shuffle_id=0, transport="fused",
+                    rounds=1, dispatches=1, records=40, record_bytes=16,
+                    plan_s=0.01, exchange_s=0.05, sort_s=0.0,
+                    per_peer_records=[10, 10, 10, 10])
+        base.update(kw)
+        return ExchangeSpan(**base)
+
+    def test_schema_version_is_twelve(self):
+        assert SCHEMA_VERSION == 12
+        assert self._make().schema == 12
+
+    def test_v11_line_parses_under_v12_reader(self):
+        """A pre-tracing journal line: the trace fields default to
+        'outside any job' and the line's own schema stamp survives."""
+        d = self._make().to_dict()
+        for f in V12_ONLY_FIELDS:
+            d.pop(f)
+        d["schema"] = 11
+        span = ExchangeSpan.from_dict(d)
+        assert span.schema == 11
+        assert span.trace_id == "" and span.job == ""
+        assert span.stage == "" and span.stage_attempt == 0
+
+    def test_v12_line_parses_under_v11_reader(self):
+        """The v11 reader is the same drop-unknown-keys from_dict minus
+        the v12 fields; a v12 line must lose nothing it relied on."""
+        d = self._make(trace_id="t1-1", job="tpcds_q95",
+                       stage="probe_join", stage_attempt=2).to_dict()
+        assert d["trace_id"] == "t1-1" and d["stage_attempt"] == 2
+        v11_view = {k: v for k, v in d.items()
+                    if k not in V12_ONLY_FIELDS}
+        span = ExchangeSpan.from_dict(v11_view)  # what a v11 reader builds
+        assert span.records == d["records"]
+        assert span.phase_s == d["phase_s"]
+        assert span.per_peer_records == d["per_peer_records"]
+
+    def test_round_trip_preserves_trace_coordinates(self):
+        span = self._make(trace_id="t2-9", job="als", stage="update_users",
+                          stage_attempt=3)
+        back = ExchangeSpan.from_dict(span.to_dict())
+        assert (back.trace_id, back.job, back.stage, back.stage_attempt) \
+            == ("t2-9", "als", "update_users", 3)
+
+    def test_job_line_is_a_new_kind_not_span_fields(self):
+        """Like alert lines (v10 -> v11): an older reader's kind
+        dispatch skips {"kind": "job"} wholesale rather than
+        misparsing it as a span."""
+        jt = trace.JobTrace("j", clock=make_clock(0.0))
+        line = jt.close(now=1.0)
+        assert line["kind"] == "job"
+        assert set(line) == trace.JOB_FIELDS
+        for st in line["stages"]:
+            assert set(st) == trace.STAGE_FIELDS
+
+    def test_field_sets_match_emitters(self):
+        """Drift guard both ways: the frozensets the lint pins are
+        exactly what build_line/to_record emit (the runtime check in
+        trace.py raises on drift; this pins the sets stay literal)."""
+        assert "stages" in trace.JOB_FIELDS
+        assert "bottleneck" in trace.STAGE_FIELDS
+        assert trace.STAGE_IDLE not in cp.PHASES
+
+    def test_workload_stage_names_are_declared(self):
+        for name in ("co_partition", "probe_join", "item_join",
+                     "rank_update", "update_users", "chunk_sort",
+                     "repartition", "join"):
+            assert name in trace.STAGE_VOCAB
+
+
+# ---------------------------------------------------------------------
+# TSDB per-job history rings
+# ---------------------------------------------------------------------
+
+class TestTsdbJobRings:
+    def _store(self, history=4):
+        reg = MetricsRegistry()
+        return TelemetryStore(reg, window_s=0.0, history=history)
+
+    def _line(self, job="q", tenant="", ts=1.0, wall=2.0):
+        return {"kind": "job", "job": job, "tenant": tenant, "ts": ts,
+                "trace_id": f"t-{ts}", "wall_s": wall}
+
+    def test_ring_caps_history_per_job(self):
+        store = self._store(history=3)
+        for i in range(5):
+            store.observe_job(self._line(ts=float(i)))
+        hist = store.job_history("q")
+        assert len(hist) == 3
+        assert [h["ts"] for h in hist] == [2.0, 3.0, 4.0]
+
+    def test_rings_keyed_by_tenant_and_job(self):
+        store = self._store()
+        store.observe_job(self._line(job="q", tenant="a"))
+        store.observe_job(self._line(job="q", tenant="b"))
+        assert len(store.job_history("q", tenant="a")) == 1
+        assert len(store.job_history("q", tenant="b")) == 1
+        assert store.job_history("q") == []
+        assert sorted(store.stats()["job_series"]) == ["a/q", "b/q"]
+
+    def test_job_lines_newest_last_with_limit(self):
+        store = self._store()
+        for i in range(4):
+            store.observe_job(self._line(job=f"j{i % 2}", ts=float(i)))
+        lines = store.job_lines()
+        assert [ln["ts"] for ln in lines] == [0.0, 1.0, 2.0, 3.0]
+        assert [ln["ts"] for ln in store.job_lines(limit=2)] == [2.0, 3.0]
+
+    def test_job_trace_feeds_wired_store(self):
+        store = self._store()
+        jt = trace.JobTrace("fed", store=store, clock=make_clock(0.0))
+        jt.close(now=1.0)
+        (got,) = store.job_history("fed")
+        assert got is jt.line
+
+
+# ---------------------------------------------------------------------
+# probe /jobs route
+# ---------------------------------------------------------------------
+
+class TestProbeJobs:
+    def test_jobs_route_serves_wired_source(self):
+        lines = [{"kind": "job", "job": "q95", "trace_id": "t-1",
+                  "wall_s": 1.5}]
+        srv = ProbeServer(0, metrics=MetricsRegistry(),
+                          identity={"process_index": 0},
+                          jobs=lambda: list(lines))
+        srv.start()
+        try:
+            body = json.loads(fetch(srv.port, "GET /jobs\n"))
+        finally:
+            srv.stop()
+        assert body["jobs"] == lines
+
+    def test_jobs_route_falls_back_to_journal_scan(self, tmp_path):
+        """A standalone manager with telemetry off still serves its
+        closed jobs straight from the journal file."""
+        path = tmp_path / "j.jsonl"
+        job_line = {"kind": "job", "job": "scan_me", "trace_id": "t-2"}
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"kind": "stall", "span_id": 1}) + "\n")
+            f.write(json.dumps(job_line) + "\n")
+        srv = ProbeServer(0, metrics=MetricsRegistry(),
+                          identity={"process_index": 0},
+                          journal_path=str(path))
+        srv.start()
+        try:
+            body = json.loads(fetch(srv.port, "GET /jobs\n"))
+        finally:
+            srv.stop()
+        assert body["jobs"] == [job_line]
+
+    def test_jobs_route_empty_without_sources(self):
+        srv = ProbeServer(0, metrics=MetricsRegistry(),
+                          identity={"process_index": 0})
+        srv.start()
+        try:
+            body = json.loads(fetch(srv.port, "GET /jobs\n"))
+        finally:
+            srv.stop()
+        assert body["jobs"] == []
+
+
+# ---------------------------------------------------------------------
+# golden CLI runs against the checked-in multi-stage fixture
+# ---------------------------------------------------------------------
+
+class TestGoldenCLIs:
+    """The fixture journal (tests/fixtures/multistage_journal.jsonl) is
+    one two-stage tpcds_q95 trace: spans for co_partition (0.6s wall,
+    fabric-bound) and probe_join (0.8s wall, codec-bound, dominant),
+    0.3s stage:idle on a 1.7s job, plus an admission wait, an alert
+    fire/resolve pair, a rollup window and a heartbeat — regenerate
+    with the obs/trace.py API if the schema moves."""
+
+    def test_fixture_parses_and_pins_v12(self):
+        entries = read_entries(str(FIXTURE))
+        kinds = sorted(e.get("kind", "span") for e in entries)
+        assert kinds == ["admission", "alert", "alert", "heartbeat",
+                         "job", "rollup", "span", "span"]
+        (jb,) = [e for e in entries if e.get("kind") == "job"]
+        assert jb["schema"] == 12 and jb["stage_count"] == 2
+        for e in entries:
+            if e.get("kind") in ("span", "rollup", "heartbeat",
+                                 "admission", "job"):
+                assert e["trace_id"] == "tfix00-1"
+
+    def test_shuffle_report_jobs_tree_and_doctor(self, capsys):
+        report = _load_script("shuffle_report")
+        assert report.main([str(FIXTURE), "--jobs", "--doctor"]) == 0
+        out = capsys.readouterr().out
+        assert "job tpcds_q95 [tfix00-1]" in out
+        assert "verdict: dominant stage 'probe_join' is codec-bound" \
+            in out
+        assert "co_partition" in out and "probe_join" in out
+        assert "0.3000s idle" in out
+        # stage-targeted remediation from STAGE_ADVICE
+        assert "stage 'probe_join'" in out
+
+    def test_shuffle_report_json_jobs_section(self, capsys):
+        report = _load_script("shuffle_report")
+        assert report.main([str(FIXTURE), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        (job,) = rep["jobs"].values()
+        assert job["job"] == "tpcds_q95"
+        assert job["dominant_stage"] == "probe_join"
+        assert job["wall_s"] == pytest.approx(1.7)
+        assert job["stage_idle_s"] == pytest.approx(0.3)
+        walls = {s["stage"]: s["wall_s"] for s in job["stages"]}
+        assert walls == {"co_partition": pytest.approx(0.6),
+                         "probe_join": pytest.approx(0.8)}
+
+    def test_shuffle_top_once_renders_job_columns_and_panel(self, capsys):
+        top = _load_script("shuffle_top")
+        assert top.main([str(FIXTURE), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "1 job trace(s)" in out
+        header = [ln for ln in out.splitlines()
+                  if ln.startswith("SHUFFLE")][0]
+        assert "JOB" in header and "STAGE" in header
+        assert "co_partition" in out and "probe_join" in out
+        jobs_header = [ln for ln in out.splitlines()
+                       if ln.startswith("JOB ")][0]
+        assert "DOMINANT" in jobs_header and "VERDICT" in jobs_header
+        assert "codec-bound" in out
+
+    def test_shuffle_trace_job_track_and_instants(self, tmp_path):
+        strace = _load_script("shuffle_trace")
+        out_path = tmp_path / "trace.json"
+        assert strace.main([str(FIXTURE), "-o", str(out_path)]) == 0
+        doc = json.loads(out_path.read_text())
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        # the per-job track group lives above _JOB_PID_BASE
+        job_x = [e for e in events
+                 if e.get("pid", 0) >= 1000 and e.get("ph") == "X"]
+        by_name = {e["name"]: e for e in job_x}
+        assert by_name["tpcds_q95"]["dur"] == pytest.approx(1.7e6)
+        assert by_name["co_partition"]["dur"] == pytest.approx(0.6e6)
+        assert by_name["probe_join"]["dur"] == pytest.approx(0.8e6)
+        # admission waits and alert transitions render as instants
+        instants = {e["name"] for e in events if e.get("ph") == "i"}
+        assert "admission:wait" in instants
+        assert "ALERT fire: spill_storm" in instants
+        assert "ALERT resolve: spill_storm" in instants
+
+
+# ---------------------------------------------------------------------
+# E2E on the CPU mesh (acceptance)
+# ---------------------------------------------------------------------
+
+class TestE2EJobTrace:
+    def test_q95_four_surfaces_agree(self, tmp_path, rng):
+        """Acceptance: one q95 run under ``manager.job`` yields ONE
+        trace whose two stages appear in the journal, the report's job
+        tree, the Perfetto export and the probe ``/jobs`` route — all
+        four agreeing on stage count, per-stage wall-clock and the
+        dominant stage — and the partition invariant holds."""
+        from sparkrdma_tpu.workloads.tpcds import run_q95_shape
+
+        sink = tmp_path / "journal.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink))
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            with manager.job("tpcds_q95") as job:
+                res = run_q95_shape(manager, sales_rows_per_device=64,
+                                    return_rows_per_device=16)
+            assert res.verified
+            line = job.line
+        finally:
+            manager.stop()
+
+        # surface 1: the journal line
+        entries = read_entries(str(sink))
+        (jb,) = [e for e in entries if e.get("kind") == "job"]
+        assert jb["trace_id"] == line["trace_id"]
+        assert jb["stage_count"] == 2
+        stage_names = [s["stage"] for s in jb["stages"]]
+        assert stage_names == ["co_partition", "probe_join"]
+        walls = {s["stage"]: s["wall_s"] for s in jb["stages"]}
+        for w in walls.values():
+            assert w > 0
+        # the partition invariant on real numbers
+        stage_phase_total = sum(total(s["phase_s"])
+                                for s in jb["stages"])
+        assert math.isclose(stage_phase_total + jb["stage_idle_s"],
+                            jb["wall_s"], rel_tol=1e-4, abs_tol=1e-3)
+        dominant = jb["dominant_stage"]
+        assert dominant == max(walls, key=walls.get)
+
+        # surface 2: shuffle_report's job tree
+        report = _load_script("shuffle_report")
+        (cell,) = report.job_report([jb]).values()
+        assert cell["stage_count"] == 2
+        assert cell["dominant_stage"] == dominant
+        assert {s["stage"]: s["wall_s"] for s in cell["stages"]} == walls
+
+        # surface 3: the Perfetto export's job track group
+        strace = _load_script("shuffle_trace")
+        doc = strace.build_trace({str(sink): entries})
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        job_x = {e["name"]: e for e in events
+                 if e.get("pid", 0) >= 1000 and e.get("ph") == "X"}
+        assert set(job_x) == {"tpcds_q95", "co_partition", "probe_join"}
+        for name, wall in walls.items():
+            assert job_x[name]["dur"] == pytest.approx(wall * 1e6,
+                                                       rel=1e-3)
+
+        # surface 4: probe /jobs (journal-scan fallback — the
+        # standalone-manager path)
+        srv = ProbeServer(0, metrics=MetricsRegistry(),
+                          identity={"process_index": 0},
+                          journal_path=str(sink))
+        srv.start()
+        try:
+            body = json.loads(fetch(srv.port, "GET /jobs\n"))
+        finally:
+            srv.stop()
+        (probed,) = body["jobs"]
+        assert probed["trace_id"] == jb["trace_id"]
+        assert probed["stage_count"] == 2
+        assert probed["dominant_stage"] == dominant
+        assert {s["stage"]: s["wall_s"]
+                for s in probed["stages"]} == walls
+
+    def test_recorded_span_carries_trace_coordinates(self, tmp_path,
+                                                     rng):
+        """A recorded read inside an explicit stage stamps the span
+        with the trace coordinates AND feeds its attribution back into
+        the stage profile."""
+        sink = tmp_path / "journal.jsonl"
+        conf = ShuffleConf(slot_records=64, metrics_sink=str(sink),
+                           collect_shuffle_read_stats=True)
+        manager = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            mesh = manager.runtime.num_partitions
+            x = rng.integers(0, 2**32, size=(mesh * 64, 4),
+                             dtype=np.uint32)
+            with manager.job("stamped") as job:
+                with job.stage("exchange"):
+                    handle = manager.register_shuffle(
+                        91, mesh, modulo_partitioner(mesh))
+                    manager.get_writer(handle).write(
+                        manager.runtime.shard_records(x)).stop(True)
+                    manager.get_reader(handle).read()
+            line = job.line
+        finally:
+            manager.stop()
+        entries = read_entries(str(sink))
+        (span,) = [e for e in entries if e.get("kind", "span") == "span"]
+        assert span["trace_id"] == line["trace_id"]
+        assert span["job"] == "stamped"
+        assert span["stage"] == "exchange"
+        (st,) = line["stages"]
+        assert st["spans"] == 1
+        assert st["records"] == x.shape[0]
+        # the span's real attribution reached the stage profile: at
+        # least one concrete (non-"other") phase observed
+        assert any(p != "other" and v > 0
+                   for p, v in st["phase_s"].items())
+        assert st["bottleneck"] in cp.VERDICTS
