@@ -59,8 +59,15 @@ interleaved trials, reporting ``overhead_pct`` and an ``ok`` flag
 against the 1% budget (BENCH_TELEMETRY_RECORDS /
 BENCH_TELEMETRY_TRIALS size it).
 
+A query-planner leg also runs on every backend: the TPC-DS star-schema
+suite (two 3-dim-join GROUP BY queries sharing a fact table) through
+the DAG optimizer with every ``plan_*`` rewrite on, reported as
+``queries_per_hour`` with the run's ``plan.*`` rewrite counters
+alongside (BENCH_PLANNER_RECORDS / BENCH_PLANNER_SCALES size it;
+off-TPU the stats label the run ``interpret``).
+
 Regression gate: set BENCH_BASELINE_DIR to a directory and every leg's
-GB/s is judged against the persisted cross-run baseline
+number is judged against the persisted cross-run baseline
 (obs/baseline.py median/MAD EWMA, keyed by mesh geometry) BEFORE this
 run's numbers are folded in — the JSON grows a ``regression_gate``
 section with per-leg ``{baseline, delta_pct, regressed}`` verdicts;
@@ -121,25 +128,30 @@ def _regression_gate(legs: dict, baseline_dir: str, regress_pct: float,
     keyed by mesh geometry so a topology change never reads as a
     regression).
 
-    Each leg with a throughput number gets ``{"baseline", "delta_pct",
+    Each leg with a measured number gets ``{"baseline", "delta_pct",
     "regressed"}``: ``regressed`` is true when the leg scored more than
     ``regress_pct`` percent BELOW the persisted baseline median. A leg
     with no baseline yet seeds one and is never flagged (``baseline``
     and ``delta_pct`` null). The run's observations are folded in and
     saved AFTER the comparison, so a regressed run is judged against
     history, not against itself.
+
+    Leg names carry their unit (``faithful_gbps``,
+    ``planner_queries_per_hour``, ...) and persist as
+    ``bench.<leg>`` — every metric where bigger is better gates the
+    same way, throughput or query rate.
     """
     from sparkrdma_tpu.obs.baseline import BaselineStore
 
     store = BaselineStore(baseline_dir)
     verdicts = {}
     for leg in sorted(legs):
-        gbps = legs[leg]
-        if gbps is None or gbps <= 0:
+        value = legs[leg]
+        if value is None or value <= 0:
             continue
-        ent = store.get(f"bench.{leg}_gbps", geometry=geometry)
+        ent = store.get(f"bench.{leg}", geometry=geometry)
         baseline = ent["median"] if ent else None
-        delta_pct = (round((gbps / baseline - 1.0) * 100.0, 3)
+        delta_pct = (round((value / baseline - 1.0) * 100.0, 3)
                      if baseline else None)
         verdicts[leg] = {
             "baseline": round(baseline, 3) if baseline else None,
@@ -147,7 +159,7 @@ def _regression_gate(legs: dict, baseline_dir: str, regress_pct: float,
             "regressed": (delta_pct is not None
                           and delta_pct < -regress_pct),
         }
-        store.observe(f"bench.{leg}_gbps", gbps, geometry=geometry)
+        store.observe(f"bench.{leg}", value, geometry=geometry)
     store.save()
     return {
         "baseline_dir": baseline_dir,
@@ -492,6 +504,71 @@ def run_multitenant(record_words: int, records_per_device: int,
     return aggregate / mesh_size, stats
 
 
+def run_planner(records_per_device: int, scales, journal: str = ""):
+    """Query-planner leg: the TPC-DS star-schema suite
+    (``workloads.tpcds.run_star_suite`` — two 3-dim-join GROUP BY
+    queries sharing one fact table) at each scale factor, every
+    ``plan_*`` rewrite ON, numpy-verified per query. Runs on EVERY
+    backend: the planner's wins (exchanges skipped, bytes not shipped,
+    outputs adopted) are structural, so the relative number is real on
+    the CPU mesh even where absolute wall-clock is not — off-TPU it is
+    labeled ``interpret`` in the stats. Returns
+    ``(queries_per_hour, stats)`` where the rate covers every verified
+    query across all scales and the stats carry the run's ``plan.*``
+    rewrite counters (how many exchanges the planner ELIDED to earn
+    the rate)."""
+    import jax
+
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.plan import PlanExecutor
+    from sparkrdma_tpu.workloads.tpcds import run_star_suite
+
+    slot = max(4096, records_per_device * max(scales))
+    kw = {"metrics_sink": journal} if journal else {}
+    conf = ShuffleConf(slot_records=slot,
+                       max_rounds=64,
+                       max_slot_records=max(1 << 22, 2 * slot),
+                       val_words=4,
+                       geometry_classes="fine",
+                       collect_shuffle_read_stats=True, **kw)
+    manager = ShuffleManager(MeshRuntime(conf), conf)
+    ex = PlanExecutor(manager)
+    try:
+        queries = 0
+        per_scale = {}
+        t0 = time.perf_counter()
+        for scale in scales:
+            res = run_star_suite(manager,
+                                 fact_rows_per_device=records_per_device,
+                                 scale=scale, executor=ex)
+            if not res.verified:
+                return -1.0, {"error": f"scale {scale} verification "
+                                       "FAILED"}
+            queries += 2           # q_star_rev + q_star_all
+            per_scale[f"sf{scale}"] = {
+                "fact_rows": res.fact_rows,
+                "suite_seconds": round(res.suite_s, 3),
+            }
+        elapsed = time.perf_counter() - t0
+        snap = manager.metrics.snapshot()
+        stats = {
+            "queries": queries,
+            "scales": list(scales),
+            "records_per_device": records_per_device,
+            "mode": ("tpu" if jax.default_backend() == "tpu"
+                     else "interpret"),
+            "per_scale": per_scale,
+            "plan_counters": {k: v for k, v in sorted(snap.items())
+                              if k.startswith("plan.")},
+            "e2e_seconds": round(elapsed, 3),
+        }
+        qph = queries / elapsed * 3600.0 if elapsed > 0 else 0.0
+        return qph, stats
+    finally:
+        manager.stop()
+
+
 def run_telemetry_overhead(records_per_device: int, repeats: int,
                            trials: int = 3):
     """Telemetry-store overhead A/B — the "never in the data path"
@@ -614,7 +691,7 @@ def main(argv=None) -> int:
         baseline_dir = os.environ.get("BENCH_BASELINE_DIR", "")
         if baseline_dir:
             single["regression_gate"] = _regression_gate(
-                {f"w{explicit_words}": gbps}, baseline_dir,
+                {f"w{explicit_words}_gbps": gbps}, baseline_dir,
                 float(os.environ.get("BENCH_REGRESS_PCT", 10.0)),
                 geometry=f"w{len(jax.devices())}")
         print(json.dumps(single))
@@ -660,6 +737,22 @@ def main(argv=None) -> int:
     telemetry_trials = int(os.environ.get("BENCH_TELEMETRY_TRIALS", 3))
     telemetry_stats = run_telemetry_overhead(telemetry_rpd, repeats,
                                              trials=telemetry_trials)
+    # query-planner leg (every backend): the star-schema suite through
+    # the DAG optimizer, reported as queries/hour. BENCH_PLANNER_RECORDS
+    # / BENCH_PLANNER_SCALES size it (defaults stay CPU-tractable).
+    planner_rpd = int(os.environ.get("BENCH_PLANNER_RECORDS", 128))
+    planner_scales = tuple(
+        int(s) for s in os.environ.get("BENCH_PLANNER_SCALES",
+                                       "1,2").split(","))
+    planner_qph, planner_stats = run_planner(planner_rpd, planner_scales,
+                                             journal=args.journal)
+    if planner_qph < 0:
+        print(json.dumps({"error": "planner leg FAILED",
+                          "detail": planner_stats}))
+        return 1
+    if args.journal:
+        planner_stats["critical_path"] = _critical_path_summary(
+            args.journal)
     # fused remote-DMA ring leg (round 8): same faithful geometry over
     # transport="pallas_ring" (ring_fused default). TPU-only — interpret
     # mode would take hours at bench scale and measure nothing real.
@@ -705,6 +798,8 @@ def main(argv=None) -> int:
         "combine_rbk_gbps_per_chip": round(combine_gbps, 3),
         "combine_rbk_metrics": combine_stats,
         "telemetry_overhead": telemetry_stats,
+        "queries_per_hour": round(planner_qph, 3),
+        "planner_metrics": planner_stats,
     }
     if ring_fused is not None:
         out["terasort_ring_fused_gbps_per_chip"] = round(ring_fused, 3)
@@ -743,12 +838,14 @@ def main(argv=None) -> int:
     baseline_dir = os.environ.get("BENCH_BASELINE_DIR", "")
     if baseline_dir:
         legs = {
-            "faithful": faithful,
-            "width_optimal": optimal,
-            "combine_rbk": combine_gbps,
-            "ring_fused": out.get("terasort_ring_fused_gbps_per_chip"),
-            "oversub": out.get("terasort_oversub_gbps_per_chip"),
-            "multitenant": out.get("multitenant_gbps_per_chip"),
+            "faithful_gbps": faithful,
+            "width_optimal_gbps": optimal,
+            "combine_rbk_gbps": combine_gbps,
+            "ring_fused_gbps": out.get(
+                "terasort_ring_fused_gbps_per_chip"),
+            "oversub_gbps": out.get("terasort_oversub_gbps_per_chip"),
+            "multitenant_gbps": out.get("multitenant_gbps_per_chip"),
+            "planner_queries_per_hour": planner_qph,
         }
         out["regression_gate"] = _regression_gate(
             legs, baseline_dir,

@@ -461,6 +461,75 @@ def run_combine_leg(args, common: dict, tmp: str) -> dict:
     }
 
 
+def run_planner_leg(args, common: dict, tmp: str) -> dict:
+    """Query-planner rewrites under chaos vs the naive knobs-off control.
+
+    The star-schema suite (workloads/tpcds.py) runs twice over the same
+    seeded tables: a fault-free CONTROL with every ``plan_*`` knob OFF
+    (the naive replay arm), then a CHAOS pass with every rewrite ON
+    under transient ``exchange.dispatch`` faults — sunk filters,
+    broadcast builds and adopted reuse outputs must all survive retries
+    and still produce the control's exact grouped sums. Verdict fields:
+
+    - ``identical``: chaos (rewrites + faults) == control, group for
+      group, sum for sum — and both arms numpy-verified
+    - ``rewrote``: the chaos arm really exercised the planner (its
+      ``plan.reuse_hits`` and ``plan.broadcast_joins`` counters are
+      non-zero; the control arm, knobs off, has none)
+    - ``sites_hit``: the dispatch fault site must be on the path
+    """
+    from sparkrdma_tpu import MeshRuntime, ShuffleConf
+    from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+    from sparkrdma_tpu.workloads.tpcds import run_star_suite
+
+    rpd = max(args.records_per_device // 16, 32)
+    geom = dict(common, val_words=4,      # the 3-join chain's W=6 shape
+                collect_shuffle_read_stats=True)
+
+    def leg(conf):
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            res = run_star_suite(m, fact_rows_per_device=rpd, scale=1,
+                                 seed=args.seed)
+            snap = m.metrics.snapshot()
+            plan_counters = {k: int(v) for k, v in snap.items()
+                             if k.startswith("plan.")}
+            return res, plan_counters, sorted(m.faults.sites_hit())
+        finally:
+            m.stop()
+
+    conf_ctl = ShuffleConf(spill_dir=os.path.join(tmp, "plan_ctl"),
+                           plan_pushdown=False, plan_reuse=False,
+                           plan_broadcast_join=False, plan_overlap=False,
+                           **geom)
+    control, ctl_counters, _ = leg(conf_ctl)
+
+    from sparkrdma_tpu import faults
+    faults.reset_accounting()
+    conf_x = ShuffleConf(spill_dir=os.path.join(tmp, "plan_chaos"),
+                         fault_spec="exchange.dispatch:fail@attempt<2",
+                         **geom)
+    chaos, counters, sites = leg(conf_x)
+
+    identical = (
+        control.verified and chaos.verified
+        and (control.rev_groups, control.rev_total,
+             control.all_groups, control.all_total)
+        == (chaos.rev_groups, chaos.rev_total,
+            chaos.all_groups, chaos.all_total))
+    rewrote = (counters.get("plan.reuse_hits", 0) > 0
+               and counters.get("plan.broadcast_joins", 0) > 0
+               and not ctl_counters)
+    ok = identical and rewrote and "exchange.dispatch" in sites
+    return {
+        "ok": ok,
+        "identical": identical,
+        "rewrote": rewrote,
+        "plan_counters": counters,
+        "sites_hit": sites,
+    }
+
+
 def run_alert_leg(args, common: dict, tmp: str) -> dict:
     """Alerting E2E: chaos must fire and journal spill + straggler
     alerts — surfaced by the probe's ``/alerts`` AND by
@@ -740,12 +809,19 @@ def main(argv=None) -> int:
               "quiet...", file=sys.stderr, flush=True)
         alert_leg = run_alert_leg(args, common, tmp)
 
+        # --- planner pass (fresh accounting) ---------------------------
+        faults.reset_accounting()
+        print("planner pass: DAG rewrites under faults vs naive "
+              "knobs-off control...", file=sys.stderr, flush=True)
+        planner_leg = run_planner_leg(args, common, tmp)
+
     identical = {leg: outputs_equal(control[leg], chaos[leg])
                  for leg in control}
     sites = plane.sites_hit()
     ok = (all(identical.values()) and len(sites) >= 6 and books
           and not spans_missing_backoff and tenant_leg["ok"]
-          and combine_leg["ok"] and alert_leg["ok"])
+          and combine_leg["ok"] and alert_leg["ok"]
+          and planner_leg["ok"])
 
     print(json.dumps({
         "ok": ok,
@@ -764,6 +840,7 @@ def main(argv=None) -> int:
         "tenant_leg": tenant_leg,
         "combine_leg": combine_leg,
         "alert_leg": alert_leg,
+        "planner_leg": planner_leg,
     }, default=str))
     return 0 if ok else 1
 

@@ -118,7 +118,7 @@ def split_kinds(entries: List[dict]) -> Dict[str, List[dict]]:
     compat: a v4 journal must not break a v3 report)."""
     out: Dict[str, List[dict]] = {
         "span": [], "stall": [], "rollup": [], "heartbeat": [],
-        "admission": [], "alert": [], "job": []}
+        "admission": [], "alert": [], "job": [], "plan": []}
     for e in entries:
         k = e.get("kind") or "span"
         if k in out:
@@ -654,14 +654,34 @@ def print_critical_path(cp: dict) -> None:
                   f"+{st['delta_s']:.4f}s ({st['ratio']:.2f}x spread)")
 
 
-def job_report(jobs: List[dict]) -> dict:
+def job_report(jobs: List[dict],
+               plans: Sequence[dict] = ()) -> dict:
     """Per-job rollup of the schema-v12 ``{"kind": "job"}`` lines.
 
     Each line is already a closed job's aggregate (``obs/trace.py``
     built it from the live stage scopes + span attributions); this just
     shapes them for display, keyed ``trace_id/job``, newest last.
     Duplicate trace ids (rotated journals re-read) keep the newest line.
+
+    ``plans`` are the schema-v13 ``{"kind": "plan"}`` lines the query
+    planner journals as it rewrites a job's DAG; they attach to the
+    same ``trace_id/job`` key as a per-rewrite tally plus the reuse
+    evidence (adopted exchanges + bytes they did NOT re-ship).
     """
+    plan_cells: Dict[str, dict] = {}
+    for pl in plans:
+        pkey = (f"{pl.get('trace_id', '') or '?'}/"
+                f"{pl.get('job', '') or '?'}")
+        cell = plan_cells.setdefault(
+            pkey, {"decisions": 0, "rewrites": {}, "reuse_hits": 0,
+                   "reuse_bytes_saved": 0})
+        rw = str(pl.get("rewrite", "") or "?")
+        cell["decisions"] += 1
+        cell["rewrites"][rw] = cell["rewrites"].get(rw, 0) + 1
+        if rw == "reuse":
+            cell["reuse_hits"] += 1
+            cell["reuse_bytes_saved"] += int(
+                pl.get("bytes_saved", 0) or 0)
     out: Dict[str, dict] = {}
     for jb in sorted(jobs, key=lambda e: float(e.get("ts", 0.0) or 0.0)):
         key = f"{jb.get('trace_id', '') or '?'}/{jb.get('job', '') or '?'}"
@@ -711,6 +731,8 @@ def job_report(jobs: List[dict]) -> dict:
             "phase_s": {p: round(v, 6) for p, v in phases.items()},
             "stages": stages,
         }
+        if key in plan_cells:
+            out[key]["plan"] = plan_cells[key]
     return out
 
 
@@ -725,6 +747,15 @@ def print_jobs(jobs_rep: dict) -> None:
               f"+ {jb['stage_idle_s']:.4f}s idle, {jb['spans']} span(s), "
               f"{jb['records']:,} records")
         print(f"    verdict: dominant stage '{dom}' is {verdict}")
+        plan = jb["plan"] if "plan" in jb else None
+        if plan:
+            tally = "  ".join(
+                f"{rw}={n}" for rw, n in sorted(plan["rewrites"].items()))
+            saved = (f", reuse saved "
+                     f"{_fmt_bytes(plan['reuse_bytes_saved'])} on the wire"
+                     if plan["reuse_hits"] else "")
+            print(f"    planner: {plan['decisions']} rewrite(s) "
+                  f"[{tally}]{saved}")
         stages = jb["stages"]
         for i, st in enumerate(stages):
             tee = "└─" if i == len(stages) - 1 else "├─"
@@ -879,7 +910,8 @@ STAGE_ADVICE = {
 
 def diagnose(spans: List[dict], stalls: List[dict],
              alerts: Sequence[dict] = (),
-             jobs: Sequence[dict] = ()) -> List[str]:
+             jobs: Sequence[dict] = (),
+             plans: Sequence[dict] = ()) -> List[str]:
     """Rule-based symptom -> knob mapping (the --doctor section).
 
     Journaled ``alert`` lines are first-class evidence, reported AHEAD
@@ -1076,6 +1108,45 @@ def diagnose(spans: List[dict], stalls: List[dict],
                 "stages (stage:idle) — the driver-side glue (host "
                 "prep, splitter sampling, result collection) is the "
                 "bottleneck, not any shuffle stage")
+    # missed shuffle-output reuse (schema v13): two exchanges inside one
+    # traced job with identical wire shape but different shuffle ids is
+    # the signature of a recomputed sub-DAG — the planner's reuse memo
+    # (plan_reuse) would have adopted the first exchange's output and
+    # shipped the duplicate for free. Journaled {"kind": "plan"} reuse
+    # lines are the positive evidence that the memo already engaged, so
+    # jobs carrying one are exempt.
+    reused_jobs = {f"{pl.get('trace_id', '') or ''}/"
+                   f"{pl.get('job', '') or ''}"
+                   for pl in plans if pl.get("rewrite") == "reuse"}
+    shapes: Dict[Tuple, set] = {}
+    for s in spans:
+        jkey = (f"{s.get('trace_id', '') or ''}/"
+                f"{s.get('job', '') or ''}")
+        if not s.get("job") or jkey in reused_jobs:
+            continue
+        shape = (jkey, int(s.get("records", 0) or 0),
+                 int(s.get("record_bytes", 0) or 0),
+                 int(s.get("total_bytes", 0) or 0))
+        if shape[3] <= 0:
+            continue
+        shapes.setdefault(shape, set()).add(
+            int(s.get("shuffle_id", -1)))
+    dup_jobs: Dict[str, int] = {}
+    for shape, sids in shapes.items():
+        if len(sids) >= 2:
+            job_name = shape[0].split("/", 1)[1] or "?"
+            waste = shape[3] * (len(sids) - 1)
+            dup_jobs[job_name] = dup_jobs.get(job_name, 0) + waste
+    if dup_jobs:
+        total_waste = sum(dup_jobs.values())
+        findings.append(
+            f"job(s) {sorted(dup_jobs)} ran multiple exchanges with "
+            "identical wire shape (records, record bytes, total bytes) "
+            f"under different shuffle ids — ~{_fmt_bytes(total_waste)} "
+            "of likely recomputed shuffle output; run the pipeline "
+            "through the query planner (Dataset.plan() / PlanExecutor "
+            "with plan_reuse=True) so the fingerprint memo adopts the "
+            "first exchange's segments instead of re-shipping them")
     corrupt = [e for s in spans for e in (s.get("events") or [])
                if e.get("name") == "fault:injected"
                and e.get("action") == "corrupt"]
@@ -1322,6 +1393,7 @@ def main(argv=None) -> int:
     admissions: List[dict] = []
     alerts: List[dict] = []
     jobs: List[dict] = []
+    plans: List[dict] = []
     for path in args.journals:
         kinds = split_kinds(load_entries(path))
         spans.extend(kinds["span"])
@@ -1331,6 +1403,7 @@ def main(argv=None) -> int:
         admissions.extend(kinds["admission"])
         alerts.extend(kinds["alert"])
         jobs.extend(kinds["job"])
+        plans.extend(kinds["plan"])
     rep = aggregate(spans)
     cp_rep = critical_path_report(spans)
     tenant_rep = tenant_breakdown({
@@ -1340,7 +1413,7 @@ def main(argv=None) -> int:
                                                      "per_shuffle": {}}
     roll_rep = aggregate_rollups(rollups)
     hb_rep = heartbeat_summary(heartbeats)
-    jobs_rep = job_report(jobs)
+    jobs_rep = job_report(jobs, plans)
     multi_host = len(hosts_rep["hosts"]) > 1
     if args.json:
         rep["hosts"] = hosts_rep
@@ -1351,7 +1424,7 @@ def main(argv=None) -> int:
         rep["tenants"] = tenant_rep["tenants"]
         rep["jobs"] = jobs_rep
         if args.doctor:
-            rep["doctor"] = diagnose(spans, stalls, alerts, jobs)
+            rep["doctor"] = diagnose(spans, stalls, alerts, jobs, plans)
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
@@ -1377,7 +1450,7 @@ def main(argv=None) -> int:
             print_stalls(stalls)
         if args.doctor:
             print("doctor:")
-            for line in diagnose(spans, stalls, alerts, jobs):
+            for line in diagnose(spans, stalls, alerts, jobs, plans):
                 print(f"  - {line}")
     return 0
 

@@ -65,6 +65,31 @@ def main() -> int:
     finally:
         m.stop()
 
+    # star-schema suite through the query planner (every plan_* rewrite
+    # on, W=6 geometry) vs the all-knobs-off naive replay — numpy-
+    # verified on chip AND bit-identical across the two arms
+    from sparkrdma_tpu.workloads.tpcds import run_star_suite
+
+    star = {}
+    for arm, knobs in (("on", {}),
+                       ("off", dict(plan_pushdown=False,
+                                    plan_reuse=False,
+                                    plan_broadcast_join=False,
+                                    plan_overlap=False))):
+        pconf = ShuffleConf(slot_records=1 << 13, val_words=4, **knobs)
+        mp = ShuffleManager(MeshRuntime(pconf), pconf)
+        try:
+            star[arm] = run_star_suite(mp, fact_rows_per_device=1 << 10,
+                                       scale=2)
+        finally:
+            mp.stop()
+    results["tpcds_star_planner"] = (
+        star["on"].verified and star["off"].verified
+        and (star["on"].rev_groups, star["on"].rev_total,
+             star["on"].all_groups, star["on"].all_total)
+        == (star["off"].rev_groups, star["off"].rev_total,
+            star["off"].all_groups, star["off"].all_total))
+
     from sparkrdma_tpu.workloads.als import run_als
     from sparkrdma_tpu.workloads.pagerank import run_pagerank
 

@@ -302,6 +302,10 @@ class Dataset:
         #: live column set after a projection ran (None = all columns);
         #: projected-away columns decode as zeros / empty bytes
         self.projected: Optional[Tuple[str, ...]] = None
+        #: memo of :meth:`_materialize_pending` — chained host exits
+        #: (``count`` then ``to_host_rows``) on one dataset instance run
+        #: the fused filter+select pass ONCE, not once per exit
+        self._materialized: Optional["Dataset"] = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -559,7 +563,9 @@ class Dataset:
                   key_ordering: bool = False,
                   aggregator: Optional[str] = None,
                   float_payload: bool = False,
-                  op: str = "exchange") -> "Dataset":
+                  op: str = "exchange",
+                  combine_hint: Optional[Tuple[bool, float]] = None
+                  ) -> "Dataset":
         m = self.manager
         # job tracing: when this pipeline runs under `manager.job(...)`
         # each exchange-backed op self-annotates as a stage named after
@@ -568,12 +574,14 @@ class Dataset:
         with _trace.auto_stage(op):
             return self._exchange_traced(
                 partitioner, num_parts, key_ordering, aggregator,
-                float_payload)
+                float_payload, combine_hint)
 
     def _exchange_traced(self, partitioner: Callable, num_parts: int,
                          key_ordering: bool = False,
                          aggregator: Optional[str] = None,
-                         float_payload: bool = False) -> "Dataset":
+                         float_payload: bool = False,
+                         combine_hint: Optional[Tuple[bool, float]] = None
+                         ) -> "Dataset":
         m = self.manager
         # consume pending logical ops: they fuse into the exchange
         # program (filtered rows never occupy a round slot; projected
@@ -602,7 +610,7 @@ class Dataset:
             out, totals = m.get_reader(
                 handle, key_ordering=key_ordering, aggregator=aggregator,
                 float_payload=float_payload, row_filter=row_filter,
-                keep_words=keep_words).read()
+                keep_words=keep_words, combine_hint=combine_hint).read()
             # detach from the pool before unregister releases the buffer
             # (schema survives layout-preserving exchanges; an
             # aggregator rewrites payload words, so the layout claim no
@@ -681,11 +689,19 @@ class Dataset:
         words before their shuffle). Filtered-out rows become reserved
         null-key filler (every downstream verb already excludes those);
         projected-away payload words zero out, matching the re-widened
-        wire semantics of the fused path bit for bit."""
+        wire semantics of the fused path bit for bit.
+
+        The result is MEMOIZED on this instance: a chained
+        ``filter().select()`` dataset visited by several host exits
+        (``count``, then ``to_host_rows``) composes both pending ops
+        into one pass run once, instead of re-materializing per exit
+        (pinned by tests/test_dataset.py's parity test)."""
         pred = self._pending_filter
         sel = self._pending_select
         if pred is None and sel is None:
             return self
+        if self._materialized is not None:
+            return self._materialized
         m = self.manager
         mesh = m.runtime.num_partitions
         cap = self.records.shape[1] // mesh
@@ -730,6 +746,7 @@ class Dataset:
                       schema=self.schema)
         if sel is not None:
             res.projected = sel
+        self._materialized = res
         return res
 
     # ------------------------------------------------------------------
@@ -827,15 +844,21 @@ class Dataset:
                             op="sort_by_key")
 
     def reduce_by_key(self, op: str = "sum",
-                      float_payload: bool = False) -> "Dataset":
+                      float_payload: bool = False,
+                      combine_hint: Optional[Tuple[bool, float]] = None
+                      ) -> "Dataset":
         """Combine payloads per unique key (rdd.reduceByKey): hash
-        co-partition + the reader's fused aggregator."""
+        co-partition + the reader's fused aggregator. ``combine_hint``
+        feeds a plan-time hoisted combine-gate decision
+        (``ShuffleExchange.plan_combine``) — the query planner's
+        per-node hoist; None keeps the in-exchange sampling gate."""
         m = self.manager
         num_parts = m.runtime.num_partitions
         part = hash_partitioner(num_parts, m.conf.key_words)
         return self._exchange(part, num_parts, aggregator=op,
                               float_payload=float_payload,
-                              op="reduce_by_key")
+                              op="reduce_by_key",
+                              combine_hint=combine_hint)
 
     def distinct(self) -> "Dataset":
         """Unique FULL rows (rdd.distinct): duplicates are co-located by
@@ -1096,6 +1119,19 @@ class Dataset:
         # fn's output is a fresh compiled-program result (not a pooled
         # exchange buffer), so no detach copy is needed
         return joined, totals
+
+    def plan(self, name: str = ""):
+        """Lift this dataset into a lazy
+        :class:`~sparkrdma_tpu.plan.LogicalPlan` source node. Verbs
+        chained on the plan build a DAG instead of executing; the
+        optimizer (plan/optimizer.py) then sinks filters/selects into
+        exchanges, reuses identical exchanges, selects broadcast joins
+        and overlaps stages before anything runs. ``name`` gives the
+        source a stable identity for the reuse fingerprint (unnamed
+        sources are deduplicated within one plan only)."""
+        from sparkrdma_tpu.plan import LogicalPlan
+
+        return LogicalPlan.dataset(self, name=name)
 
     @staticmethod
     def collect_rows(cols: jax.Array, totals: np.ndarray) -> np.ndarray:

@@ -531,6 +531,51 @@ def decode_cols_from_device(manager, records: jax.Array, totals,
     return keys, _merge_col_parts(schema, col_parts)
 
 
+class HostPrefetcher:
+    """Single background worker for deferred host->device encodes.
+
+    The query planner's stage-overlap rewrite (plan/executor.py) uses
+    this to run stage k+1's host serde work — ``Dataset.from_host_rows``
+    of a deferred plan source — while stage k's exchange drains, the
+    coarse-grained sibling of this module's per-chunk encode/H2D
+    overlap. One worker thread (encodes are host-CPU bound; more would
+    fight the exchange's own producer threads for cores), keyed
+    futures, exceptions surface at :meth:`take` — the same
+    fail-at-the-consumer contract as the encode producer above.
+    """
+
+    _TIMEOUT_S = 30.0
+
+    def __init__(self):
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._futs: dict = {}
+
+    def submit(self, key, fn) -> None:
+        """Schedule ``fn()`` on the worker under ``key`` (idempotent:
+        a key already in flight is left alone)."""
+        if key in self._futs:
+            return
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="plan-prefetch")
+        self._futs[key] = self._pool.submit(fn)
+
+    def take(self, key):
+        """Block on and return ``key``'s result (None if never
+        submitted). Raises whatever ``fn`` raised, or TimeoutError if
+        the encode wedged past the watchdog."""
+        fut = self._futs.pop(key, None)
+        if fut is None:
+            return None
+        return fut.result(timeout=self._TIMEOUT_S)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        self._futs.clear()
+
+
 __all__ = ["encode_rows_to_device", "decode_rows_from_device",
            "encode_cols_to_device", "decode_cols_from_device",
-           "staging_pool"]
+           "staging_pool", "HostPrefetcher"]

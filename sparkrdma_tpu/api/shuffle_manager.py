@@ -175,7 +175,8 @@ class ShuffleReader:
                  aggregator: Optional[str] = None,
                  float_payload: bool = False,
                  row_filter: Optional[Callable] = None,
-                 keep_words: Optional[Tuple[int, ...]] = None):
+                 keep_words: Optional[Tuple[int, ...]] = None,
+                 combine_hint: Optional[Tuple[bool, float]] = None):
         self._m = manager
         self._h = handle
         self.start_partition = start_partition
@@ -208,6 +209,11 @@ class ShuffleReader:
         self.float_payload = float_payload
         self.row_filter = row_filter
         self.keep_words = keep_words
+        #: plan-time hoisted combine-gate decision ``(use, dup_ratio)``
+        #: (``ShuffleExchange.plan_combine``) — when set, the exchange
+        #: skips its in-line duplicate-ratio sampling and consumes this
+        #: instead (the query planner's per-node hoist)
+        self.combine_hint = combine_hint
 
     def read(self, record_stats: bool = True) -> Tuple[jax.Array, jax.Array]:
         """Execute the planned exchange; return ``(records, totals)``.
@@ -309,6 +315,8 @@ class ShuffleReader:
                                                if fuse_agg else False),
                                 row_filter=self.row_filter,
                                 keep_words=self.keep_words,
+                                combine_hint=(self.combine_hint
+                                              if fuse_agg else None),
                             )
                         if filtered:
                             with Timer() as ts, annotate_span(
@@ -851,16 +859,19 @@ class ShuffleManager:
                    aggregator: Optional[str] = None,
                    float_payload: bool = False,
                    row_filter: Optional[Callable] = None,
-                   keep_words: Optional[Tuple[int, ...]] = None
+                   keep_words: Optional[Tuple[int, ...]] = None,
+                   combine_hint: Optional[Tuple[bool, float]] = None
                    ) -> ShuffleReader:
         """``row_filter``/``keep_words`` push a predicate / projection
         into the exchange program itself (full partition range only):
         filtered rows never occupy a slot, projected-away payload words
-        never hit the wire (they come back zero-filled). See
+        never hit the wire (they come back zero-filled).
+        ``combine_hint`` feeds a plan-time hoisted combine-gate decision
+        (``ShuffleExchange.plan_combine``) to an aggregator read. See
         :meth:`ShuffleExchange.exchange`."""
         return ShuffleReader(self, handle, start_partition, end_partition,
                              key_ordering, aggregator, float_payload,
-                             row_filter, keep_words)
+                             row_filter, keep_words, combine_hint)
 
     def job(self, name: str) -> "_trace.JobTrace":
         """Open a job trace over the exchanges that follow::
@@ -1009,10 +1020,13 @@ class ShuffleManager:
         return w
 
     def checkpoint_segments(self, shuffle_id: int, segments,
-                            plan: ShufflePlan, num_parts: int) -> None:
+                            plan: Optional[ShufflePlan],
+                            num_parts: int) -> None:
         """Persist chunked map output as independent CRC'd segment files
         (see :meth:`MapOutputStore.save_segments`) — the durable twin of
         the tiered store's chunk keys, enabling :meth:`resume_segments`.
+        ``plan`` is None for exchange-OUTPUT checkpoints (the query
+        planner's reuse cache), which resume from the manifest alone.
         """
         if self.store is None:
             raise RuntimeError("no MapOutputStore configured "

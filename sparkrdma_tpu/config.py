@@ -145,6 +145,39 @@ class ShuffleConf:
     #: failing the job — the PR-5 ladder's combine rung.
     combine_fallback: bool = True
 
+    # --- query planner (plan/ package) rewrite gates ---
+    #: sink plan-level ``filter``/``select`` nodes through
+    #: layout-preserving nodes into the earliest downstream exchange's
+    #: ``row_filter``/``keep_words`` (and hoist the combine gate's
+    #: duplicate-ratio sampling to plan time). Off = the naive executor
+    #: materializes each filter/select eagerly, so dropped rows still
+    #: ride the wire as null-key filler. Results are bit-identical
+    #: either way; only wire bytes and pass count change.
+    plan_pushdown: bool = True
+    #: deduplicate identical exchanges across a plan (and across plans
+    #: sharing one executor): the second node with the same canonical
+    #: exchange fingerprint adopts the first's output instead of
+    #: re-exchanging; with a segment store configured the output is
+    #: also persisted via ``checkpoint_segments`` so a restarted
+    #: executor resumes it via ``resume_segments``.
+    plan_reuse: bool = True
+    #: replace a dimension-lookup shuffle join with a broadcast join
+    #: when the build side's plan-time row count fits
+    #: ``plan_broadcast_records``: the small side replicates to every
+    #: device and NEITHER side exchanges. Construction failure (e.g. a
+    #: non-unique build key) degrades back to the shuffle join through
+    #: the standard ladder (sticky, counted as
+    #: ``degrade.broadcast_join``).
+    plan_broadcast_join: bool = True
+    #: stage-overlap scheduling: the plan executor starts stage k+1's
+    #: host encode (the api/pipeline.py chunked-overlap path) on a
+    #: background worker while stage k's exchange tail drains.
+    plan_overlap: bool = True
+    #: broadcast-join eligibility threshold: maximum build-side row
+    #: count that may replicate to every device. 0 disables broadcast
+    #: selection even when ``plan_broadcast_join`` is on.
+    plan_broadcast_records: int = 4096
+
     # --- reduce-side sort ---
     #: use the Pallas merge-path sort for fused key-ordering when the
     #: geometry allows (power-of-two output >= 2 runs). It orders by the
@@ -471,6 +504,9 @@ class ShuffleConf:
                              "no sampling, 'auto' behaves as 'on')")
         if not 0.0 <= self.combine_min_dup_ratio <= 1.0:
             raise ValueError("combine_min_dup_ratio must be in [0, 1]")
+        if self.plan_broadcast_records < 0:
+            raise ValueError("plan_broadcast_records must be >= 0 (0 = "
+                             "never broadcast)")
         if self.wide_sort_min_payload < 0:
             raise ValueError("wide_sort_min_payload must be >= 0")
         if self.wide_sort_ride_words < 0:

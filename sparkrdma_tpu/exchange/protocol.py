@@ -362,6 +362,19 @@ class ShuffleExchange:
         even with combine off — so every aggregator span journals the
         duplication signal ``shuffle_report --doctor``'s missed-combine
         rule reads."""
+        use, ratio = self.plan_combine(records, aggregator)
+        if aggregator:
+            self.metrics.counter(
+                "combine.gate_on" if use else "combine.gate_off").inc()
+        return use, ratio
+
+    def plan_combine(self, records, aggregator: str) -> Tuple[bool, float]:
+        """PLAN-TIME combine gate: the same decision as the in-exchange
+        gate, computed off the exchange's critical path (the query
+        planner hoists it per reduce node and hands the result back as
+        :meth:`exchange`'s ``combine_hint``). Does NOT bump the gate
+        counters — the exchange that consumes the decision does, so
+        hoisted and inline decisions count identically."""
         if not aggregator:
             return False, 0.0
         ratio = self._sampled_dup_ratio(records)
@@ -372,8 +385,6 @@ class ShuffleExchange:
             use = True
         else:
             use = ratio >= self.conf.combine_min_dup_ratio
-        self.metrics.counter(
-            "combine.gate_on" if use else "combine.gate_off").inc()
         return use, ratio
 
     def _note_wire(self, records, incoming, combined: bool,
@@ -1379,6 +1390,7 @@ class ShuffleExchange:
         float_payload: bool = False,
         row_filter: Optional[Callable] = None,
         keep_words: Optional[Tuple[int, ...]] = None,
+        combine_hint: Optional[Tuple[bool, float]] = None,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Run the planned exchange.
 
@@ -1475,12 +1487,24 @@ class ShuffleExchange:
         # failures, not construction failures — they stay on the
         # reader's retry path, never this rung.
         for attempt in (0, 1):
-            # the gate's duplicate-key sampling is host work on the
-            # exchange's critical path — timed so the attribution can
-            # charge it to the combine phase
-            self.timeline.begin("combine:gate")
-            use_combine, dup_ratio = self._combine_gate(records, aggregator)
-            self.timeline.end("combine:gate")
+            if combine_hint is not None and aggregator:
+                # plan-time hoisted decision (plan_combine): no sampling
+                # on the critical path; the sticky combine override and
+                # the fallback rung still win over a stale hint
+                use_combine, dup_ratio = combine_hint
+                use_combine = bool(use_combine) \
+                    and not self._combine_override
+                self.metrics.counter(
+                    "combine.gate_on" if use_combine
+                    else "combine.gate_off").inc()
+            else:
+                # the gate's duplicate-key sampling is host work on the
+                # exchange's critical path — timed so the attribution can
+                # charge it to the combine phase
+                self.timeline.begin("combine:gate")
+                use_combine, dup_ratio = self._combine_gate(records,
+                                                            aggregator)
+                self.timeline.end("combine:gate")
             try:
                 out, totals, incoming = self._dispatch(
                     records, partitioner, plan, num_parts, shuffle_id,

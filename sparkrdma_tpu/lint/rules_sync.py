@@ -733,3 +733,97 @@ def check_trace_schema_sync(ctx: LintContext) -> List[Finding]:
                         "register the name so report/doctor advice "
                         "and lint stay in sync", obj="sparkrdma_tpu"))
     return findings
+
+
+# ---------------------------------------------------------------------
+# plan-schema-sync
+# ---------------------------------------------------------------------
+
+#: plan-line access pattern; by convention the CLIs bind a
+#: ``{"kind": "plan"}`` dict to ``pl`` before reading fields from it
+#: (the span/rb/hb/al/jb convention)
+PLAN_GET = re.compile(r'\bpl\.get\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+
+
+def _plan_line_keys(sf: SourceFile) -> Optional[tuple]:
+    """(keys, lineno) of the ``{"kind": "plan", ...}`` dict literal the
+    emitter builds, or None when no such literal exists."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = []
+        is_plan = False
+        literal = True
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                literal = False
+                break
+            keys.append(k.value)
+            if k.value == "kind" and isinstance(v, ast.Constant) \
+                    and v.value == "plan":
+                is_plan = True
+        if literal and is_plan:
+            return set(keys), node.lineno
+    return None
+
+
+@rule("plan-schema-sync",
+      "the plan-line emitter matches PLAN_FIELDS exactly and CLI "
+      "plan-field reads exist on the schema", kind="schema-sync")
+def check_plan_schema_sync(ctx: LintContext) -> List[Finding]:
+    """Convention the rule pins: CLIs bind a ``{"kind": "plan"}`` dict
+    to ``pl`` before reading fields (the span/rb/hb/al/jb convention),
+    and ``plan/executor.py`` builds the journal line as a literal dict
+    next to its ``PLAN_FIELDS`` declaration. The executor's own
+    RuntimeError drift check runs only when a rewrite actually fires;
+    this rule catches the drift at lint time, on both sides."""
+    exec_sf = ctx.file("sparkrdma_tpu/plan/executor.py")
+    if exec_sf is None:
+        return []
+    findings = []
+    fields = _frozen_field_set(exec_sf, "PLAN_FIELDS")
+    if fields is None:
+        return [Finding("plan-schema-sync", exec_sf.rel, 0,
+                        "plan/executor.py must declare PLAN_FIELDS as a "
+                        "literal frozenset of strings",
+                        obj="sparkrdma_tpu")]
+
+    # (a) the emitter's dict literal carries exactly PLAN_FIELDS —
+    # both directions, so a key added to one side must hit the other
+    line_keys = _plan_line_keys(exec_sf)
+    if line_keys is None:
+        findings.append(Finding(
+            "plan-schema-sync", exec_sf.rel, 0,
+            "plan/executor.py builds no literal {\"kind\": \"plan\"} "
+            "line dict — the emitter drifted from the lintable shape",
+            obj="sparkrdma_tpu"))
+    else:
+        keys, lineno = line_keys
+        for extra in sorted(keys - fields):
+            findings.append(Finding(
+                "plan-schema-sync", exec_sf.rel, lineno,
+                f"the plan line emits key {extra!r} missing from "
+                "PLAN_FIELDS — declare it", obj="sparkrdma_tpu"))
+        for missing in sorted(fields - keys):
+            findings.append(Finding(
+                "plan-schema-sync", exec_sf.rel, lineno,
+                f"PLAN_FIELDS declares {missing!r} but the plan line "
+                "never emits it — stale schema entry",
+                obj="sparkrdma_tpu"))
+
+    # (b) every CLI read of a plan field exists on the schema
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in PLAN_GET.finditer(line):
+                if m.group(1) not in fields:
+                    findings.append(Finding(
+                        "plan-schema-sync", sf.rel, lineno,
+                        f"scripts/{script} reads plan field "
+                        f"{m.group(1)!r} which does not exist in "
+                        "plan.executor.PLAN_FIELDS — rename the field "
+                        "or fix the script", obj="scripts"))
+    return findings

@@ -312,10 +312,17 @@ class MapOutputStore:
     # whole checkpoint. The manifest lands last (tmp + atomic rename) so
     # a crash mid-save reads as incomplete rather than as mixed data.
     # ------------------------------------------------------------------
-    def save_segments(self, shuffle_id: int, segments, plan: ShufflePlan,
+    def save_segments(self, shuffle_id: int, segments,
+                      plan: Optional[ShufflePlan],
                       num_parts: int) -> Path:
         """Persist ``segments`` (``[(key, np.ndarray), ...]``) as
-        individual CRC-framed files + a ``segments.json`` manifest."""
+        individual CRC-framed files + a ``segments.json`` manifest.
+
+        ``plan`` may be None for checkpoints that persist an exchange's
+        OUTPUT rather than its map-side input (the query planner's
+        reuse cache): segment-level resume reads only the manifest's
+        ``segments`` table, so output checkpoints have no ShufflePlan
+        to record."""
         d = self._dir(shuffle_id)
         d.mkdir(parents=True, exist_ok=True)
         spool = SpillWriter(depth=self.spool_depth,
@@ -349,13 +356,16 @@ class MapOutputStore:
         meta = {
             "shuffle_id": shuffle_id,
             "num_parts": num_parts,
-            "counts": plan.counts.tolist(),
-            "num_rounds": plan.num_rounds,
-            "out_capacity": plan.out_capacity,
-            "capacity": plan.capacity,
-            "split_factor": plan.split_factor,
             "segments": manifest,
         }
+        if plan is not None:
+            meta.update({
+                "counts": plan.counts.tolist(),
+                "num_rounds": plan.num_rounds,
+                "out_capacity": plan.out_capacity,
+                "capacity": plan.capacity,
+                "split_factor": plan.split_factor,
+            })
         mtmp = d / "segments.json.tmp"
         mtmp.write_text(json.dumps(meta))
         mtmp.replace(d / "segments.json")

@@ -182,6 +182,11 @@ def partition_to_wall(phase_s: Dict[str, float],
         return {}
     out = {p: float(v or 0.0) for p, v in phase_s.items()
            if p in PHASES and v}
+    # a merged input may already carry per-span "other" remainders;
+    # fold them into the recomputed remainder below instead of counting
+    # them as attributed time (and then clobbering the key, which would
+    # make the result sum to wall minus the carried value)
+    out.pop("other", None)
     total = sum(out.values())
     if total > wall_s:
         scale = wall_s / total

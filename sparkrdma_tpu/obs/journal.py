@@ -56,7 +56,11 @@ Besides spans, a journal may carry **auxiliary lines** tagged with a
   :mod:`sparkrdma_tpu.obs.trace`: per-stage critical-path profiles,
   ``stage:idle`` time, the per-job verdict — consumed by
   ``shuffle_report --jobs``, ``shuffle_top`` and the probe's ``/jobs``
-  route.
+  route;
+- ``{"kind": "plan", ...}`` — query-planner rewrite decisions (schema
+  v13) from :mod:`sparkrdma_tpu.plan.executor`: which rewrite fired on
+  which plan node and what it saved — consumed by
+  ``shuffle_report --jobs`` and the missed-reuse doctor rule.
 
 :func:`read_journal` returns spans only; :func:`read_entries` returns
 everything.
@@ -134,7 +138,16 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: profiles, stage:idle, the per-job verdict). v11↔v12 interchange is
 #: the usual drop-unknown/default-missing contract, pinned both
 #: directions by tests/test_trace.py.
-SCHEMA_VERSION = 12
+#: v13: + auxiliary ``{"kind": "plan"}`` lines (plan/executor.py
+#: PLAN_FIELDS — one line per query-planner rewrite decision:
+#: pushdown sink, exchange reuse, broadcast-join selection, stage
+#: overlap, combine-gate hoist — consumed by ``shuffle_report --jobs``
+#: and the missed-reuse doctor rule). Span fields are unchanged from
+#: v12, so v12↔v13 interchange is pure kind-tolerance like v10↔v11:
+#: a v12 reader skips the unknown kind, a v13 reader reads v12 lines
+#: verbatim (pinned both directions by tests/test_trace.py and
+#: tests/test_obs.py).
+SCHEMA_VERSION = 13
 
 
 @dataclasses.dataclass

@@ -60,6 +60,10 @@ COUNTERS = frozenset({
     "combine.fallbacks",
     "pushdown.filters",
     "pushdown.projections",
+    "plan.pushdown_sunk",
+    "plan.reuse_hits",
+    "plan.broadcast_joins",
+    "plan.overlapped_stages",
     "store.puts",
     "store.put_bytes",
     "store.spill_writes",

@@ -89,6 +89,8 @@ STAGE_VOCAB = frozenset({
     # Dataset-verb auto-stages (api/dataset.py _exchange op= names)
     "exchange", "repartition", "sort_by_key", "reduce_by_key",
     "distinct", "group_by_key", "cogroup", "join",
+    # query-planner stages (plan/executor.py)
+    "plan_optimize", "broadcast_build",
 })
 
 #: the job-level phase key charging inter-stage gaps — deliberately NOT

@@ -1,0 +1,23 @@
+"""Query planner: lazy logical plans over the Dataset shuffle verbs.
+
+``Dataset.plan()`` (or :meth:`LogicalPlan.dataset` /
+:meth:`LogicalPlan.from_host_rows`) lifts a dataset into a lazy DAG of
+shuffle-verb nodes; :class:`PlanExecutor` optimizes it (pushdown
+propagation, shuffle-output reuse, broadcast-join selection, stage
+overlap — one ShuffleConf gate each) and runs it as a stage DAG on a
+ShuffleManager under the job-trace layer. See plan/nodes.py for the
+node algebra and plan/optimizer.py for the rewrites.
+"""
+
+from sparkrdma_tpu.plan.executor import (PLAN_FIELDS, BroadcastBuildError,
+                                         PlanExecutor, plan_line,
+                                         reuse_shuffle_id)
+from sparkrdma_tpu.plan.nodes import (LogicalPlan, PlanNode,
+                                      node_fingerprint)
+from sparkrdma_tpu.plan.optimizer import optimize
+
+__all__ = [
+    "LogicalPlan", "PlanNode", "PlanExecutor", "optimize",
+    "node_fingerprint", "PLAN_FIELDS", "plan_line", "reuse_shuffle_id",
+    "BroadcastBuildError",
+]

@@ -446,6 +446,27 @@ class TestFilterSelectPushdown:
         assert got_map == ref
         assert not np.any(got[:, 3:])
 
+    def test_filter_select_one_pass_memoized(self, wide_manager, rng):
+        """A chained filter().select() visited by several host exits
+        composes both pending ops into ONE materialization pass, run
+        once and memoized on the instance — and that pass agrees with
+        numpy (the parity pin _materialize_pending's docstring names)."""
+        x = self.data(rng)
+        ds = Dataset.from_host_rows(wide_manager, x, schema=self.schema())
+        flt = ds.filter(self.odd_a, cache_key=("odd_a",)).select("a")
+        assert flt._materialized is None      # lazy until a host exit
+        ref = x[(x[:, 2] & 1) == 1].copy()
+        ref[:, 3:] = 0                        # b, c projected away
+        assert flt.count == ref.shape[0]
+        first = flt._materialized
+        assert first is not None              # count materialized once
+        np.testing.assert_array_equal(canon(flt.to_host_rows()),
+                                      canon(ref))
+        assert flt._materialized is first     # second exit reused it
+        # the memoized pass equals the fused wire path bit for bit
+        np.testing.assert_array_equal(
+            canon(flt.repartition().to_host_rows()), canon(ref))
+
     def test_filter_before_sort_and_count_by_key(self, wide_manager, rng):
         """Verbs that must materialize first (sampler/to_ones rewrite
         records) still honor a pending filter."""

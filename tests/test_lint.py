@@ -415,6 +415,68 @@ def test_trace_schema_sync_skips_without_trace_module(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# plan schema sync
+# ---------------------------------------------------------------------
+
+_PLAN_EXEC = """
+    PLAN_FIELDS = frozenset({"kind", "schema", "ts", "rewrite",
+                             "bytes_saved"})
+
+    def plan_line(rewrite, saved):
+        return {"kind": "plan", "schema": 13, "ts": 0.0,
+                "rewrite": rewrite, "bytes_saved": saved}
+"""
+
+
+def test_plan_schema_sync_clean(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/plan/executor.py": _PLAN_EXEC,
+        "scripts/shuffle_report.py": """
+            def row(pl):
+                return (pl.get("rewrite"), pl.get("bytes_saved"))
+        """,
+    })
+    assert run_rules(root, select=["plan-schema-sync"]) == []
+
+
+def test_plan_schema_sync_emitter_field_drift_both_ways(tmp_path):
+    # the line dict emits a key PLAN_FIELDS misses AND the schema
+    # declares a key the line never carries — both directions fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/plan/executor.py": _PLAN_EXEC.replace(
+            '"ts": 0.0,', '"when": 0.0,'),
+    })
+    got = run_rules(root, select=["plan-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert len(got) == 2
+    assert "'when'" in msgs and "'ts'" in msgs
+
+
+def test_plan_schema_sync_cli_ghost_field(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/plan/executor.py": _PLAN_EXEC,
+        "scripts/shuffle_top.py": """
+            def row(pl):
+                return pl.get("ghost_rows")
+        """,
+    })
+    got = run_rules(root, select=["plan-schema-sync"])
+    assert rules_of(got) == ["plan-schema-sync"]
+    assert "ghost_rows" in got[0].message
+    assert got[0].obj == "scripts"
+
+
+def test_plan_schema_sync_skips_without_executor_module(tmp_path):
+    root = repo(tmp_path, {
+        "scripts/shuffle_report.py": """
+            def row(pl):
+                return pl.get("anything_goes")
+        """,
+    })
+    assert run_rules(root, select=["plan-schema-sync"]) == []
+
+
+# ---------------------------------------------------------------------
 # timeline pairing
 # ---------------------------------------------------------------------
 
@@ -1543,7 +1605,7 @@ def test_real_repo_is_srlint_clean():
     every rule, zero findings (modulo in-source suppressions) — and the
     full run must fit the tier-1 preamble's wall-clock budget."""
     from sparkrdma_tpu.lint import all_rules
-    assert len(all_rules()) == 21, \
+    assert len(all_rules()) == 22, \
         "rule count drifted — update this pin, the README table, and " \
         "COVERAGE.md together"
     t0 = time.perf_counter()

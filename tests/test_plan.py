@@ -1,0 +1,317 @@
+"""Query-planner DAG tests: optimizer decision goldens, rewrite on/off
+bit-identity, broadcast degradation, and reuse across a restart.
+
+Every rewrite must be a pure wire/latency optimization: with any
+``plan_*`` knob combination the star suite's results are bit-identical
+to the all-knobs-off naive replay (acceptance pin for the planner PR).
+The journal is the evidence channel — ``{"kind": "plan"}`` lines name
+each decision, and span ``total_bytes`` prove the wire actually shrank.
+"""
+
+import collections
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu import MeshRuntime, ShuffleConf
+from sparkrdma_tpu.api.dataset import Dataset
+from sparkrdma_tpu.api.serde import RowSchema
+from sparkrdma_tpu.api.shuffle_manager import ShuffleManager
+from sparkrdma_tpu.plan import (LogicalPlan, PlanExecutor, optimize,
+                                plan_line, PLAN_FIELDS)
+from sparkrdma_tpu.workloads.tpcds import (_star_pred, _star_tables,
+                                           run_star_suite)
+
+ALL_OFF = dict(plan_pushdown=False, plan_reuse=False,
+               plan_broadcast_join=False, plan_overlap=False)
+
+OUT_SCHEMA = RowSchema([("a2", "uint32"), ("a3", "uint32"),
+                        ("value", "uint32"), ("a1", "uint32")])
+
+
+def _read_journal(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def _star_rev_plan(m, rows_per_device=16, name="golden"):
+    """The q_star_rev shape: 3 joins, then filter/select written AFTER
+    the pre-aggregate repartition (so the pushdown pass has work)."""
+    fact, d1t, d2t, d3t = _star_tables(8, rows_per_device, 1, 0)
+    fact_r = LogicalPlan.dataset(
+        Dataset.from_host_rows(m, fact),
+        name=f"{name}_fact").repartition(stage="fact_part")
+    d1 = LogicalPlan.from_host_rows(m, d1t, name=f"{name}_d1")
+    d2 = LogicalPlan.from_host_rows(m, d2t, name=f"{name}_d2")
+    d3 = LogicalPlan.from_host_rows(m, d3t, name=f"{name}_d3")
+    return (fact_r
+            .join(d1, key_from=0, attr_to=3, stage="dim1_join")
+            .join(d2, key_from=1, attr_to=0, stage="dim2_join")
+            .join(d3, key_from=3, attr_to=1, schema=OUT_SCHEMA,
+                  stage="dim3_join")
+            .repartition(stage="qual_part")
+            .filter(_star_pred)
+            .select("value")
+            .reduce_by_key("sum", stage="star_agg"))
+
+
+# ---------------------------------------------------------------------
+# optimizer decisions (no execution)
+# ---------------------------------------------------------------------
+
+class TestOptimizerDecisions:
+    @pytest.fixture(scope="class")
+    def manager(self):
+        conf = ShuffleConf(slot_records=1024, val_words=4)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        yield m
+        m.stop()
+
+    def test_star_rev_golden_decisions(self, manager):
+        """The canonical star query triggers every plan-time rewrite
+        with a pinned decision multiset: filter AND select each sink
+        below + fuse into the pre-aggregate repartition (4 pushdown
+        decisions), all three dim joins broadcast, all three deferred
+        dim sources overlap."""
+        q = _star_rev_plan(manager)
+        _, decisions = optimize(q.root, manager.conf)
+        assert collections.Counter(d.rewrite for d in decisions) == {
+            "pushdown": 4, "broadcast_join": 3, "overlap": 3}
+        details = [d.detail for d in decisions if d.rewrite == "pushdown"]
+        assert sum(d.startswith("sunk below") for d in details) == 2
+        assert sum(d.startswith("fused into") for d in details) == 2
+
+    def test_all_knobs_off_yields_no_decisions(self, manager):
+        q = _star_rev_plan(manager)
+        root, decisions = optimize(q.root, ShuffleConf(
+            slot_records=1024, val_words=4, **ALL_OFF))
+        assert decisions == []
+        # knobs-off optimize is structurally the identity: the naive
+        # written order survives (filter still sits above the exchange)
+        assert root.op == "reduce_by_key"
+        assert root.children[0].op == "select"
+
+    def test_sunk_exchange_refingerprints(self, manager):
+        """A repartition that had a filter sunk into it SHIPS different
+        bytes than the bare repartition of the same source — their
+        fingerprints must diverge or the reuse memo would alias them."""
+        fact, *_ = _star_tables(8, 16, 1, 0)
+        src = LogicalPlan.dataset(Dataset.from_host_rows(manager, fact),
+                                  name="refp_fact")
+        bare = src.repartition()
+        filtered = src.repartition().filter(_star_pred)
+        root_b, _ = optimize(bare.root, manager.conf)
+        root_f, _ = optimize(filtered.root, manager.conf)
+
+        def exchange_of(node):
+            while node.op != "repartition":
+                node = node.children[0]
+            return node
+
+        assert exchange_of(root_b).fp != exchange_of(root_f).fp
+
+    def test_broadcast_respects_row_ceiling(self, manager):
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           plan_broadcast_records=8)
+        q = _star_rev_plan(manager)
+        _, decisions = optimize(q.root, conf)
+        # dims are 64/32/16 rows — all above the 8-row ceiling
+        assert not [d for d in decisions if d.rewrite == "broadcast_join"]
+
+
+# ---------------------------------------------------------------------
+# rewrite on/off bit-identity + journal evidence (executed)
+# ---------------------------------------------------------------------
+
+class TestStarSuiteBitIdentity:
+    def _run_arm(self, tmp_path, arm, knobs):
+        sink = tmp_path / f"journal_{arm}.jsonl"
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           metrics_sink=str(sink),
+                           collect_shuffle_read_stats=True, **knobs)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            res = run_star_suite(m, fact_rows_per_device=16, scale=1)
+            counters = {k: v for k, v in m.metrics.snapshot().items()
+                        if k.startswith("plan.")}
+        finally:
+            m.stop()
+        return res, counters, _read_journal(str(sink))
+
+    def test_planner_on_equals_naive_off(self, tmp_path):
+        """Acceptance: planner-on and all-knobs-off arms both verify
+        against numpy and agree bit for bit, while the ON journal
+        proves >= 1 pushdown sink, >= 1 reuse adoption, >= 1 broadcast
+        join and a >= 2x wire-byte drop."""
+        on, on_counters, on_journal = self._run_arm(tmp_path, "on", {})
+        off, off_counters, off_journal = self._run_arm(
+            tmp_path, "off", ALL_OFF)
+        assert on.verified and off.verified
+        assert (on.rev_groups, on.rev_total, on.all_groups,
+                on.all_total) == (off.rev_groups, off.rev_total,
+                                  off.all_groups, off.all_total)
+
+        plans = [e for e in on_journal if e.get("kind") == "plan"]
+        assert all(set(e) == PLAN_FIELDS for e in plans)
+        rewrites = collections.Counter(e["rewrite"] for e in plans)
+        assert sum(1 for e in plans
+                   if e["detail"].startswith("sunk below")) >= 1
+        assert rewrites["reuse"] >= 1
+        assert rewrites["broadcast_join"] >= 3
+        assert not [e for e in off_journal if e.get("kind") == "plan"]
+        assert off_counters.get("plan.reuse_hits", 0) == 0
+
+        assert on_counters["plan.pushdown_sunk"] >= 1
+        assert on_counters["plan.reuse_hits"] >= 1
+        assert on_counters["plan.broadcast_joins"] >= 3
+        assert on_counters["plan.overlapped_stages"] >= 1
+
+        def wire(journal):
+            return sum(int(e.get("total_bytes", 0) or 0)
+                       for e in journal if "shuffle_id" in e
+                       and "kind" not in e)
+
+        assert wire(off_journal) >= 2 * wire(on_journal)
+
+    @pytest.mark.parametrize("knob", ["plan_pushdown", "plan_reuse",
+                                      "plan_broadcast_join",
+                                      "plan_overlap"])
+    def test_single_knob_off_keeps_results(self, tmp_path, knob):
+        """Each rewrite degrades independently: turning exactly one
+        knob off still verifies and still matches the all-on totals."""
+        on, _, _ = self._run_arm(tmp_path, "all_on", {})
+        one, _, _ = self._run_arm(tmp_path, f"no_{knob}", {knob: False})
+        assert one.verified
+        assert (one.rev_groups, one.rev_total, one.all_groups,
+                one.all_total) == (on.rev_groups, on.rev_total,
+                                   on.all_groups, on.all_total)
+
+
+# ---------------------------------------------------------------------
+# broadcast degradation (duplicate dim PKs)
+# ---------------------------------------------------------------------
+
+class TestBroadcastDegradation:
+    def _join_rows(self, m, dim, sink_path=None):
+        rng = np.random.default_rng(7)
+        nf = 8 * 16
+        fact = np.zeros((nf, 6), dtype=np.uint32)
+        fact[:, 1] = rng.integers(1, 9, size=nf)     # lookup key 1..8
+        fact[:, 2] = rng.integers(1, 50, size=nf)    # next key
+        fact[:, 4] = rng.integers(1, 100, size=nf)   # value
+        q = (LogicalPlan.dataset(Dataset.from_host_rows(m, fact),
+                                 name="degrade_fact")
+             .repartition(stage="fact_part")
+             .join(LogicalPlan.from_host_rows(m, dim, name="degrade_dim"),
+                   key_from=0, attr_to=1, stage="bad_join")
+             .sink())
+        ex = PlanExecutor(m)
+        try:
+            return ex.run(q, job_name="degrade")
+        finally:
+            ex.close()
+
+    def _dim(self, duplicate):
+        dim = np.zeros((16, 6), dtype=np.uint32)
+        dim[:8, 1] = np.arange(1, 9)
+        dim[:8, 2] = np.arange(1, 9) * 10
+        if duplicate:
+            # a second row for PK 3 with the SAME attribute: either
+            # pick is semantically identical, but the broadcast build
+            # refuses duplicates outright and must degrade
+            dim[8, 1] = 3
+            dim[8, 2] = 30
+        return dim
+
+    def test_duplicate_pk_degrades_to_shuffle_join(self, tmp_path):
+        sink = tmp_path / "degrade.jsonl"
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           metrics_sink=str(sink))
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            rows_bad = self._join_rows(m, self._dim(duplicate=True))
+        finally:
+            m.stop()
+        offc = ShuffleConf(slot_records=1024, val_words=4, **ALL_OFF)
+        m2 = ShuffleManager(MeshRuntime(offc), offc)
+        try:
+            rows_off = self._join_rows(m2, self._dim(duplicate=True))
+        finally:
+            m2.stop()
+        assert sorted(map(tuple, rows_bad)) == sorted(map(tuple, rows_off))
+        degr = [e for e in _read_journal(str(sink))
+                if e.get("kind") == "plan"
+                and e["detail"].startswith("degraded to shuffle join")]
+        assert len(degr) == 1 and degr[0]["rewrite"] == "broadcast_join"
+
+    def test_unique_pk_broadcasts_cleanly(self, tmp_path):
+        sink = tmp_path / "clean.jsonl"
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           metrics_sink=str(sink),
+                           collect_shuffle_read_stats=True)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            self._join_rows(m, self._dim(duplicate=False))
+            snap = m.metrics.snapshot()
+        finally:
+            m.stop()
+        assert snap.get("plan.broadcast_joins", 0) == 1
+        assert not [e for e in _read_journal(str(sink))
+                    if e.get("kind") == "plan"
+                    and e["detail"].startswith("degraded")]
+
+
+# ---------------------------------------------------------------------
+# reuse across a restart (checkpoint segments -> tiered store)
+# ---------------------------------------------------------------------
+
+class TestReuseAcrossRestart:
+    def test_resume_segments_adoption(self, tmp_path):
+        rng = np.random.default_rng(11)
+        x = rng.integers(1, 2**31, size=(8 * 32, 6), dtype=np.uint32)
+
+        def run_once(tag):
+            sink = tmp_path / f"restart_{tag}.jsonl"
+            conf = ShuffleConf(slot_records=1024, val_words=4,
+                               spill_dir=str(tmp_path / "spill"),
+                               metrics_sink=str(sink),
+                               collect_shuffle_read_stats=True)
+            m = ShuffleManager(MeshRuntime(conf), conf)
+            ex = PlanExecutor(m)
+            try:
+                q = (LogicalPlan.dataset(
+                        Dataset.from_host_rows(m, x),
+                        name="restart_src")
+                     .repartition(stage="fact_part").sink())
+                rows = ex.run(q, job_name=f"restart_{tag}")
+                snap = m.metrics.snapshot()
+            finally:
+                ex.close()
+                m.stop()
+            return rows, snap, _read_journal(str(sink))
+
+        rows1, snap1, _ = run_once("first")
+        assert snap1.get("plan.reuse_hits", 0) == 0
+        # brand-new manager AND executor: the in-memory memo is gone,
+        # only the persisted checkpoint segments remain
+        rows2, snap2, journal2 = run_once("second")
+        assert snap2.get("plan.reuse_hits", 0) == 1
+        resumed = [e for e in journal2 if e.get("kind") == "plan"
+                   and e.get("rewrite") == "reuse"]
+        assert len(resumed) == 1
+        assert resumed[0]["detail"] == "adopted via resume_segments"
+        assert resumed[0]["bytes_saved"] > 0
+        assert sorted(map(tuple, rows1)) == sorted(map(tuple, rows2))
+
+
+# ---------------------------------------------------------------------
+# plan_line schema guard
+# ---------------------------------------------------------------------
+
+def test_plan_line_matches_plan_fields():
+    line = plan_line("node#0", "repartition", "reuse", "ab12", rows=3,
+                     bytes_saved=96, detail="adopted via memo")
+    assert set(line) == PLAN_FIELDS
+    assert line["kind"] == "plan" and line["rewrite"] == "reuse"
