@@ -306,6 +306,13 @@ class Dataset:
         #: (``count`` then ``to_host_rows``) on one dataset instance run
         #: the fused filter+select pass ONCE, not once per exit
         self._materialized: Optional["Dataset"] = None
+        #: content digest of the HOST rows this dataset was built from
+        #: (``serde.rows_content_digest``), stamped by
+        #: :meth:`from_host_rows` only — derived datasets (exchange
+        #: outputs, filtered views) leave it empty, which makes the
+        #: query planner treat them as identity-fingerprinted sources
+        #: instead of content-addressed ones (see plan/nodes.py)
+        self.content_digest: str = ""
 
     # ------------------------------------------------------------------
     @classmethod
@@ -333,8 +340,17 @@ class Dataset:
                 "input rows use the reserved all-ones (0xFFFFFFFF) key, "
                 "which this layer reserves for padding filler — remap "
                 "that key before loading")
-        return cls(manager, manager.runtime.shard_records(rows),
-                   schema=schema)
+        from sparkrdma_tpu.api.serde import rows_content_digest
+
+        ds = cls(manager, manager.runtime.shard_records(rows),
+                 schema=schema)
+        # content identity for the query planner's reuse caches: one
+        # sequential pass over the input bytes, small next to the
+        # shard/transfer work above, and the thing that keeps a
+        # same-shape different-data source from adopting a cached
+        # exchange output (in-process or across a restart)
+        ds.content_digest = rows_content_digest(rows)
+        return ds
 
     @classmethod
     def from_host_payloads(cls, manager: ShuffleManager, keys: np.ndarray,
@@ -1126,9 +1142,15 @@ class Dataset:
         chained on the plan build a DAG instead of executing; the
         optimizer (plan/optimizer.py) then sinks filters/selects into
         exchanges, reuses identical exchanges, selects broadcast joins
-        and overlaps stages before anything runs. ``name`` gives the
-        source a stable identity for the reuse fingerprint (unnamed
-        sources are deduplicated within one plan only)."""
+        and overlaps stages before anything runs. Source identity for
+        the reuse fingerprint is the dataset's ``content_digest`` when
+        present (stamped by :meth:`from_host_rows`), else this object's
+        process-unique token — so unnamed sources can never alias a
+        different dataset across plans, runs, or restarts. ``name``
+        additionally asserts the CONTRACT that whatever carries this
+        name holds stable content for as long as any reuse cache may
+        serve it (see plan/nodes.py; break the promise and call
+        ``PlanExecutor.invalidate_reuse()``)."""
         from sparkrdma_tpu.plan import LogicalPlan
 
         return LogicalPlan.dataset(self, name=name)

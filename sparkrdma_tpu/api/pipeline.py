@@ -541,7 +541,12 @@ class HostPrefetcher:
     overlap. One worker thread (encodes are host-CPU bound; more would
     fight the exchange's own producer threads for cores), keyed
     futures, exceptions surface at :meth:`take` — the same
-    fail-at-the-consumer contract as the encode producer above.
+    fail-at-the-consumer contract as the encode producer above. The
+    plan executor treats any :meth:`take` failure (including the
+    watchdog TimeoutError) as a fall-back-to-synchronous-encode signal,
+    since the prefetch is a pure latency optimization; callers are
+    expected to :meth:`drain` at run boundaries so an aborted run's
+    unconsumed futures can never leak into a later one.
     """
 
     _TIMEOUT_S = 30.0
@@ -568,6 +573,15 @@ class HostPrefetcher:
         if fut is None:
             return None
         return fut.result(timeout=self._TIMEOUT_S)
+
+    def drain(self) -> None:
+        """Discard every outstanding future (run-boundary reset).
+        Not-yet-started encodes are cancelled; an in-flight one just
+        completes on the worker and is garbage-collected unconsumed.
+        The pool stays up for the next run's submissions."""
+        for fut in self._futs.values():
+            fut.cancel()
+        self._futs.clear()
 
     def close(self) -> None:
         if self._pool is not None:

@@ -76,6 +76,7 @@ combined totals.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
 from typing import List, Optional, Sequence, Tuple
@@ -970,7 +971,22 @@ def decode_cols(
     return keys, cols
 
 
+def rows_content_digest(rows: np.ndarray) -> str:
+    """Canonical 16-hex content digest of a host row batch (shape,
+    dtype and bytes). One digest value <=> one bit pattern, so the
+    query planner uses it as a cache-safe source identity: the reuse
+    memo and the durable ``checkpoint_segments`` cache may adopt one
+    source's exchange output for another ONLY when their digests match
+    (``Dataset.from_host_rows`` stamps it as ``content_digest``;
+    plan/nodes.py folds it into source fingerprints)."""
+    r = np.ascontiguousarray(rows)
+    h = hashlib.sha256()
+    h.update(repr((r.shape, r.dtype.name)).encode())
+    h.update(r.data)
+    return h.hexdigest()[:16]
+
+
 __all__ = ["encode_bytes_rows", "decode_bytes_rows", "payload_words",
            "native_codec_available", "codec_totals", "RowSchema",
            "BytesColumn", "encode_cols", "decode_cols",
-           "columnar_enabled"]
+           "columnar_enabled", "rows_content_digest"]

@@ -1021,17 +1021,22 @@ class ShuffleManager:
 
     def checkpoint_segments(self, shuffle_id: int, segments,
                             plan: Optional[ShufflePlan],
-                            num_parts: int) -> None:
+                            num_parts: int,
+                            extra_meta: Optional[dict] = None) -> None:
         """Persist chunked map output as independent CRC'd segment files
         (see :meth:`MapOutputStore.save_segments`) — the durable twin of
         the tiered store's chunk keys, enabling :meth:`resume_segments`.
         ``plan`` is None for exchange-OUTPUT checkpoints (the query
         planner's reuse cache), which resume from the manifest alone.
+        ``extra_meta`` adds caller fields to the manifest (the planner
+        records its full exchange fingerprint as ``plan_fp`` so resume
+        can reject a shuffle-id collision).
         """
         if self.store is None:
             raise RuntimeError("no MapOutputStore configured "
                                "(set conf.spill_dir or pass store=)")
-        self.store.save_segments(shuffle_id, segments, plan, num_parts)
+        self.store.save_segments(shuffle_id, segments, plan, num_parts,
+                                 extra_meta=extra_meta)
 
     def resume_segments(self, shuffle_id: int) -> list:
         """Restart path for chunked shuffles: adopt a segment-level

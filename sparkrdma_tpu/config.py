@@ -159,7 +159,12 @@ class ShuffleConf:
     #: exchange fingerprint adopts the first's output instead of
     #: re-exchanging; with a segment store configured the output is
     #: also persisted via ``checkpoint_segments`` so a restarted
-    #: executor resumes it via ``resume_segments``.
+    #: executor resumes it via ``resume_segments``. Fingerprints embed
+    #: each source's content digest (or a process-unique object token
+    #: when no digest exists — see plan/nodes.py), so the caches can
+    #: only ever adopt bit-identical data; the one exception is a NAMED
+    #: digest-less source, whose name is a stability contract
+    #: (``PlanExecutor.invalidate_reuse()`` is the escape hatch).
     plan_reuse: bool = True
     #: replace a dimension-lookup shuffle join with a broadcast join
     #: when the build side's plan-time row count fits

@@ -314,7 +314,8 @@ class MapOutputStore:
     # ------------------------------------------------------------------
     def save_segments(self, shuffle_id: int, segments,
                       plan: Optional[ShufflePlan],
-                      num_parts: int) -> Path:
+                      num_parts: int,
+                      extra_meta: Optional[dict] = None) -> Path:
         """Persist ``segments`` (``[(key, np.ndarray), ...]``) as
         individual CRC-framed files + a ``segments.json`` manifest.
 
@@ -322,7 +323,8 @@ class MapOutputStore:
         OUTPUT rather than its map-side input (the query planner's
         reuse cache): segment-level resume reads only the manifest's
         ``segments`` table, so output checkpoints have no ShufflePlan
-        to record."""
+        to record. ``extra_meta`` fields are merged into the manifest
+        (reserved top-level keys win over collisions)."""
         d = self._dir(shuffle_id)
         d.mkdir(parents=True, exist_ok=True)
         spool = SpillWriter(depth=self.spool_depth,
@@ -353,11 +355,12 @@ class MapOutputStore:
                           f"({errors} errors)")
         for tmp, final in tmp_paths:
             tmp.replace(final)
-        meta = {
+        meta = dict(extra_meta or {})
+        meta.update({
             "shuffle_id": shuffle_id,
             "num_parts": num_parts,
             "segments": manifest,
-        }
+        })
         if plan is not None:
             meta.update({
                 "counts": plan.counts.tolist(),
@@ -405,6 +408,21 @@ class MapOutputStore:
         out = []
         for p in self.root.glob("shuffle_*"):
             if (p / _META).exists():
+                try:
+                    out.append(int(p.name.split("_", 1)[1]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def list_segment_checkpoints(self) -> List[int]:
+        """Shuffle ids holding a SEGMENT-level checkpoint (a
+        ``segments.json`` manifest) — disjoint bookkeeping from
+        :meth:`list_shuffles`, which lists whole-output ``meta.json``
+        checkpoints. The planner's ``invalidate_reuse`` sweeps this
+        list for its durable reuse entries."""
+        out = []
+        for p in self.root.glob("shuffle_*"):
+            if (p / "segments.json").exists():
                 try:
                     out.append(int(p.name.split("_", 1)[1]))
                 except ValueError:

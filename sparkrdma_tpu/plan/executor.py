@@ -78,9 +78,15 @@ PLAN_FIELDS = frozenset({
 
 #: Durable reuse-cache shuffle ids: derived from the exchange
 #: fingerprint so a restarted process computes the same id, parked in
-#: their own range above the Dataset layer's ``1 << 20`` counter.
+#: their own range above the Dataset layer's ``1 << 20`` counter. The
+#: span keeps all 44 low bits of the 48-bit fingerprint (birthday
+#: collisions are negligible at any plausible cache size), and the
+#: manifest additionally records the FULL fingerprint — ``_persist``
+#: never overwrites a different fingerprint's entry, ``_try_resume``
+#: treats a mismatch as a miss — so even a colliding id can only cost
+#: a cache slot, never serve wrong segments.
 _REUSE_ID_BASE = 1 << 24
-_REUSE_ID_SPAN = 1 << 20
+_REUSE_ID_SPAN = 1 << 44
 
 
 def reuse_shuffle_id(fp: str) -> int:
@@ -144,7 +150,7 @@ class PlanExecutor:
         """Optimize + execute; returns host rows for a ``sink`` root, a
         ``GroupedData`` for a ``group_by_key`` root, else a Dataset."""
         m = self.manager
-        self._results = {}
+        self._reset_run_state()
         with m.job(job_name or plan.name or "plan"):
             with _trace.stage("plan_optimize"):
                 root, decisions = optimize(plan.root, m.conf)
@@ -157,10 +163,21 @@ class PlanExecutor:
         planner-built fragment inside an explicitly staged workload
         (tpcds q95's ``co_partition`` stage) without changing the
         job's stage profile."""
-        self._results = {}
+        self._reset_run_state()
         root, decisions = optimize(plan.root, self.manager.conf)
         self._journal_decisions(decisions)
         return self._exec(root)
+
+    def _reset_run_state(self) -> None:
+        """Run-boundary reset: per-run source results AND the prefetch
+        bookkeeping. A prior run (especially an aborted one) may have
+        left unconsumed encode futures in the prefetcher; draining them
+        here keeps a stale Dataset from ever being handed to a later
+        run's source node."""
+        self._results = {}
+        self._prefetched.clear()
+        if self._prefetcher is not None:
+            self._prefetcher.drain()
 
     def _journal_decisions(self, decisions) -> None:
         m = self.manager
@@ -233,8 +250,25 @@ class PlanExecutor:
             m = node.manager or self.manager
             ds = None
             if self._prefetcher is not None and \
-                    id(node) in self._prefetched:
-                ds = self._prefetcher.take(id(node))
+                    node.fp in self._prefetched:
+                self._prefetched.discard(node.fp)
+                try:
+                    ds = self._prefetcher.take(node.fp)
+                except Exception as exc:
+                    # overlap is a pure latency optimization: a wedged
+                    # or failed background encode must degrade to the
+                    # synchronous path, never fail the query
+                    _faults.note_degradation("plan_overlap",
+                                             reason=str(exc))
+                    self.manager.journal.emit_raw(plan_line(
+                        node.label, node.op, "overlap", node.fp,
+                        detail=f"prefetch failed, synchronous encode "
+                               f"fallback: {exc}"))
+                    log.warning(
+                        "plan overlap prefetch of %s failed (%s); "
+                        "encoding synchronously", node.label or "source",
+                        exc)
+                    ds = None
             if ds is None:
                 ds = Dataset.from_host_rows(m, node.rows,
                                             schema=node.schema)
@@ -288,20 +322,54 @@ class PlanExecutor:
 
     def _persist(self, fp: str, ds: Dataset) -> None:
         m = self.manager
+        sid = reuse_shuffle_id(fp)
         try:
+            try:
+                existing = m.store.load_segment_meta(sid)
+            except KeyError:
+                existing = None
+            if existing is not None and \
+                    existing.get("plan_fp") not in (None, fp):
+                # derived-id collision: keep the first entry — evicting
+                # it would silently shrink the durable cache, and the
+                # colliding fingerprint simply stays memo-only
+                log.warning(
+                    "plan reuse id collision: shuffle id %d already "
+                    "holds fingerprint %s; keeping it, not persisting "
+                    "%s", sid, existing.get("plan_fp"), fp)
+                return
             m.checkpoint_segments(
-                reuse_shuffle_id(fp),
+                sid,
                 [(f"plan{fp}:cols", np.asarray(ds.records)),
                  (f"plan{fp}:totals", np.asarray(ds.totals))],
-                plan=None, num_parts=m.runtime.num_partitions)
+                plan=None, num_parts=m.runtime.num_partitions,
+                extra_meta={"plan_fp": fp})
         except Exception as exc:           # cache write, never fatal
             log.warning("plan reuse persist of %s failed: %s", fp, exc)
 
     def _try_resume(self, fp: str, node: PlanNode) -> Optional[Tuple]:
-        """Cross-restart adoption: segment checkpoint -> tiered store."""
+        """Cross-restart adoption: segment checkpoint -> tiered store.
+
+        The manifest must carry OUR full fingerprint: the checkpoint
+        shuffle id keeps only 44 fingerprint bits, so a missing or
+        different ``plan_fp`` (id collision, pre-fingerprint manifest)
+        reads as a miss, never as someone else's segments."""
         m = self.manager
+        sid = reuse_shuffle_id(fp)
         try:
-            m.resume_segments(reuse_shuffle_id(fp))
+            meta = m.store.load_segment_meta(sid)
+        except KeyError:
+            return None
+        except Exception as exc:
+            log.warning("plan reuse manifest of %s unreadable: %s",
+                        fp, exc)
+            return None
+        if meta.get("plan_fp") != fp:
+            log.info("plan reuse: shuffle id %d holds fingerprint %s, "
+                     "wanted %s — miss", sid, meta.get("plan_fp"), fp)
+            return None
+        try:
+            m.resume_segments(sid)
             cols = m.tiered.get(f"plan{fp}:cols")
             totals = m.tiered.get(f"plan{fp}:totals")
         except KeyError:
@@ -347,15 +415,18 @@ class PlanExecutor:
 
     def _maybe_prefetch(self, dim_node: PlanNode) -> None:
         """Rewrite 4: start the marked dim source's host encode on a
-        background worker before the fact subtree executes."""
+        background worker before the fact subtree executes. Keyed by
+        the node FINGERPRINT, not ``id()`` — fingerprints are
+        content-stable and non-recyclable, so a garbage-collected prior
+        run's node can never alias a fresh one (CPython reuses ids)."""
         src = dim_node
         while src.children:
             src = src.children[0]
         if not (self.manager.conf.plan_overlap
                 and src.op == "source" and src.prefetch
-                and src.rows is not None):
+                and src.rows is not None and src.fp):
             return
-        if id(src) in self._prefetched or id(src) in self._results:
+        if src.fp in self._prefetched or id(src) in self._results:
             return
         if self._prefetcher is None:
             from sparkrdma_tpu.api.pipeline import HostPrefetcher
@@ -363,9 +434,9 @@ class PlanExecutor:
             self._prefetcher = HostPrefetcher()
         manager = src.manager or self.manager
         rows, schema = src.rows, src.schema
-        self._prefetched.add(id(src))
+        self._prefetched.add(src.fp)
         self._prefetcher.submit(
-            id(src),
+            src.fp,
             lambda: Dataset.from_host_rows(manager, rows, schema=schema))
         self.manager.metrics.counter("plan.overlapped_stages").inc()
 
@@ -512,6 +583,28 @@ class PlanExecutor:
         return fn
 
     # ------------------------------------------------------------------
+    def invalidate_reuse(self) -> None:
+        """Explicit reuse-cache invalidation: drop the in-memory memo
+        and delete every durable plan-reuse checkpoint in the manager's
+        store. The escape hatch for the named-source contract (see
+        plan/nodes.py): sources whose content the planner cannot digest
+        are adopted on the promise that their name means stable data —
+        call this when that promise breaks (a named table was reloaded
+        with new rows) before running the next plan."""
+        self._memo.clear()
+        m = self.manager
+        if m.store is None:
+            return
+        for sid in m.store.list_segment_checkpoints():
+            if sid < _REUSE_ID_BASE:
+                continue
+            try:
+                is_plan = "plan_fp" in m.store.load_segment_meta(sid)
+            except (KeyError, ValueError):
+                continue
+            if is_plan:
+                m.store.delete(sid)
+
     def close(self) -> None:
         if self._prefetcher is not None:
             self._prefetcher.close()

@@ -28,16 +28,40 @@ a content hash over the subtree's ops, parameters and source
 identities. Exchange-level fingerprints derived from it key the
 executor's reuse memo (and the durable ``checkpoint_segments`` reuse
 cache) — the plan-level analogue of the exchange's compiled-program
-``_exec_cache`` key.
+``_exec_cache`` key. Because both caches OUTLIVE a single plan (the
+memo spans ``run()`` calls, the durable cache spans restarts), source
+identity must never be recyclable:
+
+- deferred host-row sources fingerprint by a full CONTENT DIGEST of
+  their rows (two sources with equal digests hold bit-identical data,
+  so adopting one for the other is always correct, in any plan, in any
+  process);
+- unnamed Dataset-backed sources fingerprint by a process-unique,
+  non-recyclable object token — they reuse only while the SAME Dataset
+  object is reachable, and can never alias a different dataset across
+  plans, runs, or restarts;
+- NAMED Dataset-backed sources fingerprint by ``(name, content
+  digest)``. ``Dataset.from_host_rows`` stamps the digest; a dataset
+  without one (e.g. an exchange output re-wrapped as a source) falls
+  back to the name alone, which is a CONTRACT: naming such a source
+  asserts its content is stable under that name for as long as any
+  reuse cache (including the durable one under ``conf.spill_dir``) may
+  serve it. Call ``PlanExecutor.invalidate_reuse()`` when the promise
+  breaks.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
+import uuid
+import weakref
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
+
+from sparkrdma_tpu.api.serde import rows_content_digest
 
 #: ops that run at least one exchange when executed (the stage
 #: boundaries of the DAG)
@@ -86,24 +110,75 @@ class PlanNode:
     fuses_into: str = ""                 # pushdown: target exchange op
     broadcast: bool = False              # join: broadcast selected
     prefetch: bool = False               # source: overlap-encode it
+    # --- fingerprint cache --------------------------------------------
+    content_fp: str = ""                 # cached digest of deferred rows
 
 
-def _fp_tuple(node: PlanNode, seen: dict) -> Tuple:
-    """Canonical structure tuple for hashing. ``seen`` maps unnamed
-    source node ids to per-plan serials so two DISTINCT anonymous
-    sources never collide, while the same node object reached twice
-    (a shared subtree) fingerprints identically."""
+#: per-process nonce folded into every object token, so a token can
+#: never equal one minted by a different process (a restarted executor
+#: must MISS the durable cache for identity-fingerprinted sources)
+_PROCESS_NONCE = uuid.uuid4().hex[:8]
+_token_counter = itertools.count()
+_OBJ_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+#: fallback table for _obj_token on objects that cannot be weak-keyed;
+#: pins the object alive, which is the price of a stable identity
+_PINNED_TOKENS: dict = {}
+
+
+def _obj_token(obj) -> str:
+    """Process-unique NON-RECYCLABLE identity token for a live object.
+
+    Unlike ``id()``, a token is never reissued after the object dies
+    (the counter only moves forward), so fingerprints built from it can
+    safely key caches that outlive the object — CPython id reuse would
+    otherwise alias a fresh dataset/predicate with a dead one's cache
+    entry."""
+    try:
+        tok = _OBJ_TOKENS.get(obj)
+        if tok is None:
+            tok = f"{_PROCESS_NONCE}.{next(_token_counter)}"
+            _OBJ_TOKENS[obj] = tok
+        return tok
+    except TypeError:
+        # unhashable / non-weakrefable callables: keep them pinned so
+        # their id cannot be recycled either
+        hit = _PINNED_TOKENS.get(id(obj))
+        if hit is not None and hit[0] is obj:
+            return hit[1]
+        tok = f"{_PROCESS_NONCE}.{next(_token_counter)}"
+        _PINNED_TOKENS[id(obj)] = (obj, tok)
+        return tok
+
+
+def _source_ident(node: PlanNode) -> Tuple:
+    """Cache-safe identity of a source node (see module docstring)."""
+    if node.rows is not None:
+        if not node.content_fp:
+            node.content_fp = rows_content_digest(node.rows)
+        digest = node.content_fp
+    else:
+        digest = getattr(node.dataset, "content_digest", "") or ""
+    if node.name:
+        return ("named", node.name, digest)
+    if digest:
+        return ("anon", digest)
+    return ("anon", _obj_token(node.dataset))
+
+
+def _fp_tuple(node: PlanNode) -> Tuple:
+    """Canonical structure tuple for hashing. Source identity is
+    content-addressed (or object-token-addressed) — see module
+    docstring — so two sources only ever share a fingerprint when
+    adopting one's exchange output for the other is bit-identical."""
     if node.op == "source":
-        if node.name:
-            ident: Tuple = ("named", node.name)
-        else:
-            ident = ("anon", seen.setdefault(id(node), len(seen)))
         shape = (tuple(node.rows.shape) if node.rows is not None
                  else tuple(node.dataset.records.shape))
-        return ("source", ident, shape)
-    kids = tuple(_fp_tuple(c, seen) for c in node.children)
+        return ("source", _source_ident(node), shape)
+    kids = tuple(_fp_tuple(c) for c in node.children)
     if node.op == "filter":
-        return ("filter", node.pred_key or ("id", id(node.pred)), kids)
+        return ("filter",
+                node.pred_key or ("anon_pred", _obj_token(node.pred)),
+                kids)
     if node.op == "select":
         return ("select", node.columns, kids)
     if node.op == "repartition":
@@ -126,9 +201,9 @@ def fingerprint_hex(payload: Tuple) -> str:
     return hashlib.sha256(repr(payload).encode()).hexdigest()[:12]
 
 
-def node_fingerprint(node: PlanNode, seen: Optional[dict] = None) -> str:
+def node_fingerprint(node: PlanNode) -> str:
     """Canonical fingerprint of the subtree rooted at ``node``."""
-    return fingerprint_hex(_fp_tuple(node, {} if seen is None else seen))
+    return fingerprint_hex(_fp_tuple(node))
 
 
 class LogicalPlan:
@@ -178,8 +253,8 @@ class LogicalPlan:
         """Predicate node (lazy, jit-safe ``uint32[W, n] -> bool[n]``
         over full-width records). Give a stable ``cache_key`` — it is
         both the compiled-program cache identity AND the reuse
-        fingerprint component (an unkeyed lambda fingerprints by object
-        id, defeating cross-plan reuse)."""
+        fingerprint component (an unkeyed lambda fingerprints by a
+        process-unique object token, defeating cross-plan reuse)."""
         key = cache_key or getattr(pred, "cache_key", None)
         return self._chain(PlanNode("filter", pred=pred, pred_key=key))
 
@@ -283,7 +358,6 @@ class LogicalPlan:
     def explain(self) -> str:
         """Indented operator tree with fingerprints — debugging aid."""
         lines: List[str] = []
-        seen: dict = {}
 
         def walk(node: PlanNode, depth: int) -> None:
             extra = ""
@@ -297,7 +371,7 @@ class LogicalPlan:
                 extra = f" columns={list(node.columns or ())}"
             elif node.op == "reduce_by_key":
                 extra = f" agg={node.agg}"
-            fp = node.fp or node_fingerprint(node, seen)
+            fp = node.fp or node_fingerprint(node)
             lines.append("  " * depth + f"{node.op}{extra} [{fp}]")
             for c in node.children:
                 walk(c, depth + 1)

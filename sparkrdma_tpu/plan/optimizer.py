@@ -96,12 +96,11 @@ def _annotate(root: PlanNode) -> Tuple[List[PlanNode], Dict[int, int]]:
     refs: Dict[int, int] = {}
     _walk(root, nodes, refs)
     counts: Dict[str, int] = {}
-    fp_seen: dict = {}
     for n in nodes:
         i = counts.get(n.op, 0)
         counts[n.op] = i + 1
         n.label = f"{n.op}#{i}"
-        n.fp = fingerprint_hex(_fp_tuple(n, fp_seen))
+        n.fp = fingerprint_hex(_fp_tuple(n))
     return nodes, refs
 
 
@@ -136,9 +135,8 @@ def _refingerprint(root: PlanNode) -> None:
     pre-rewrite fingerprint — the reuse memo would alias it with the
     bare exchange from a plan that never had the filter. Labels keep
     their pre-rewrite values (they are journal ids, not cache keys)."""
-    fp_seen: dict = {}
     for n in _all_nodes(root):
-        n.fp = fingerprint_hex(_fp_tuple(n, fp_seen))
+        n.fp = fingerprint_hex(_fp_tuple(n))
 
 
 def _mark_fusions(root: PlanNode, decisions: List[Decision]) -> None:

@@ -307,6 +307,210 @@ class TestReuseAcrossRestart:
 
 
 # ---------------------------------------------------------------------
+# reuse-cache identity safety (review regressions): the memo and the
+# durable cache OUTLIVE a plan, so source identity must never alias
+# different data — not across plans, not across restarts, not through
+# CPython id reuse, and not through a derived-shuffle-id collision.
+# ---------------------------------------------------------------------
+
+class TestReuseIdentitySafety:
+    def _run_simple(self, m, ex, data, name="", tag="q"):
+        """One repartition exchange over ``data`` -> host rows."""
+        q = (LogicalPlan.dataset(Dataset.from_host_rows(m, data),
+                                 name=name)
+             .repartition(stage="part").sink())
+        return ex.run(q, job_name=tag)
+
+    def _rows(self, seed, n=8 * 16):
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 2**31, size=(n, 6), dtype=np.uint32)
+
+    def test_anon_sources_never_alias_across_plans(self):
+        """Two plans on ONE executor, each with an unnamed same-shape
+        source holding different data: the cross-run memo must serve
+        each its own exchange output — and a third plan re-reading the
+        FIRST data must hit (content-addressed, not plan-scoped)."""
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           collect_shuffle_read_stats=True)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        ex = PlanExecutor(m)
+        try:
+            a, b = self._rows(3), self._rows(4)
+            rows_a = self._run_simple(m, ex, a, tag="qa")
+            rows_b = self._run_simple(m, ex, b, tag="qb")
+            assert sorted(map(tuple, rows_a)) == sorted(map(tuple, a))
+            assert sorted(map(tuple, rows_b)) == sorted(map(tuple, b))
+            assert m.metrics.snapshot().get("plan.reuse_hits", 0) == 0
+            rows_a2 = self._run_simple(m, ex, a, tag="qa2")
+            assert sorted(map(tuple, rows_a2)) == sorted(map(tuple, a))
+            assert m.metrics.snapshot().get("plan.reuse_hits", 0) == 1
+        finally:
+            ex.close()
+            m.stop()
+
+    def test_deferred_anon_sources_content_addressed(self):
+        """Deferred host-row sources (LogicalPlan.from_host_rows) get
+        the same content digest treatment as materialized ones."""
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           collect_shuffle_read_stats=True)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        ex = PlanExecutor(m)
+        try:
+            a, b = self._rows(5), self._rows(6)
+            for data, tag in ((a, "da"), (b, "db")):
+                q = (LogicalPlan.from_host_rows(m, data)
+                     .repartition(stage="part").sink())
+                rows = ex.run(q, job_name=tag)
+                assert sorted(map(tuple, rows)) == sorted(map(tuple,
+                                                              data))
+            assert m.metrics.snapshot().get("plan.reuse_hits", 0) == 0
+        finally:
+            ex.close()
+            m.stop()
+
+    def _restart_run(self, tmp_path, data, tag, name="mut_src",
+                     invalidate=False):
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           spill_dir=str(tmp_path / "spill"),
+                           collect_shuffle_read_stats=True)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        ex = PlanExecutor(m)
+        try:
+            if invalidate:
+                ex.invalidate_reuse()
+            rows = self._run_simple(m, ex, data, name=name, tag=tag)
+            snap = m.metrics.snapshot()
+        finally:
+            ex.close()
+            m.stop()
+        return rows, snap
+
+    def test_named_source_content_change_misses_durable_cache(
+            self, tmp_path):
+        """Restart with the SAME source name but different rows of the
+        same shape: the durable cache must not serve the stale
+        pre-restart output (its manifest fingerprint embeds the
+        content digest), while the original content still hits."""
+        x, y = self._rows(11), self._rows(12)
+        rows1, snap1 = self._restart_run(tmp_path, x, "first")
+        assert snap1.get("plan.reuse_hits", 0) == 0
+        rows2, snap2 = self._restart_run(tmp_path, y, "second")
+        assert snap2.get("plan.reuse_hits", 0) == 0
+        assert sorted(map(tuple, rows2)) == sorted(map(tuple, y))
+        rows3, snap3 = self._restart_run(tmp_path, x, "third")
+        assert snap3.get("plan.reuse_hits", 0) == 1
+        assert sorted(map(tuple, rows3)) == sorted(map(tuple, rows1))
+
+    def test_invalidate_reuse_drops_durable_entries(self, tmp_path):
+        """The named-source escape hatch: invalidate_reuse deletes the
+        durable plan checkpoints, forcing recomputation."""
+        x = self._rows(13)
+        self._restart_run(tmp_path, x, "seed")
+        _, snap = self._restart_run(tmp_path, x, "after_invalidate",
+                                    invalidate=True)
+        assert snap.get("plan.reuse_hits", 0) == 0
+
+    def test_reuse_id_collision_keeps_first_entry(self, tmp_path,
+                                                  monkeypatch):
+        """Force every fingerprint onto ONE derived shuffle id: the
+        second exchange must neither adopt the first's segments nor
+        evict them — the manifest's full fingerprint disambiguates."""
+        import sparkrdma_tpu.plan.executor as pe
+
+        monkeypatch.setattr(pe, "reuse_shuffle_id",
+                            lambda fp: pe._REUSE_ID_BASE + 7)
+        x, y = self._rows(21), self._rows(22)
+        rows1, snap1 = self._restart_run(tmp_path, x, "first", name="cx")
+        assert snap1.get("plan.reuse_hits", 0) == 0
+        # different content -> same sid: manifest fp mismatch -> miss,
+        # and the first entry survives (persist skipped, not clobbered)
+        rows2, snap2 = self._restart_run(tmp_path, y, "second",
+                                         name="cy")
+        assert snap2.get("plan.reuse_hits", 0) == 0
+        assert sorted(map(tuple, rows2)) == sorted(map(tuple, y))
+        rows3, snap3 = self._restart_run(tmp_path, x, "third", name="cx")
+        assert snap3.get("plan.reuse_hits", 0) == 1
+        assert sorted(map(tuple, rows3)) == sorted(map(tuple, rows1))
+
+    def test_unkeyed_predicate_tokens_never_recycle(self):
+        """_obj_token must not behave like id(): after an unkeyed
+        predicate dies, a new one may reuse its memory address but must
+        still fingerprint differently."""
+        import gc
+
+        from sparkrdma_tpu.plan import nodes as plan_nodes
+
+        f = lambda r: r  # noqa: E731
+        t1 = plan_nodes._obj_token(f)
+        assert plan_nodes._obj_token(f) == t1
+        del f
+        gc.collect()
+        g = lambda r: r  # noqa: E731
+        assert plan_nodes._obj_token(g) != t1
+
+
+# ---------------------------------------------------------------------
+# stage-overlap fail-soft (review regressions): overlap is a pure
+# latency optimization, so a wedged/failed background encode degrades
+# to the synchronous path instead of failing the query, and stale
+# futures never cross a run boundary.
+# ---------------------------------------------------------------------
+
+class TestOverlapFailSoft:
+    def test_prefetch_failure_degrades_to_sync_encode(self, tmp_path,
+                                                      monkeypatch):
+        from sparkrdma_tpu.api.pipeline import HostPrefetcher
+
+        def wedged(self, key):
+            raise TimeoutError("encode wedged past the watchdog")
+
+        monkeypatch.setattr(HostPrefetcher, "take", wedged)
+        sink = tmp_path / "pf.jsonl"
+        conf = ShuffleConf(slot_records=1024, val_words=4,
+                           metrics_sink=str(sink))
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        try:
+            res = run_star_suite(m, fact_rows_per_device=16, scale=1)
+        finally:
+            m.stop()
+        assert res.verified
+        falls = [e for e in _read_journal(str(sink))
+                 if e.get("kind") == "plan"
+                 and e["detail"].startswith("prefetch failed")]
+        assert falls and all(e["rewrite"] == "overlap" for e in falls)
+
+    def test_drain_discards_stale_futures(self):
+        from sparkrdma_tpu.api.pipeline import HostPrefetcher
+
+        hp = HostPrefetcher()
+        try:
+            hp.submit("k", lambda: 1)
+            hp.drain()
+            assert hp.take("k") is None
+        finally:
+            hp.close()
+
+    def test_rerun_on_one_executor_stays_correct(self):
+        """Back-to-back runs on one executor: run-boundary reset keeps
+        the second run's sources from adopting first-run prefetch
+        state keyed by recycled identity."""
+        conf = ShuffleConf(slot_records=1024, val_words=4)
+        m = ShuffleManager(MeshRuntime(conf), conf)
+        ex = PlanExecutor(m)
+        try:
+            res1 = run_star_suite(m, fact_rows_per_device=16, scale=1,
+                                  executor=ex)
+            res2 = run_star_suite(m, fact_rows_per_device=16, scale=1,
+                                  executor=ex)
+            assert res1.verified and res2.verified
+            assert (res1.rev_groups, res1.rev_total) == \
+                (res2.rev_groups, res2.rev_total)
+        finally:
+            ex.close()
+            m.stop()
+
+
+# ---------------------------------------------------------------------
 # plan_line schema guard
 # ---------------------------------------------------------------------
 
