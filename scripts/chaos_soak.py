@@ -48,7 +48,19 @@ seed replays exactly.
    pure wire-size optimization — retries that replay a combined
    dispatch must never change what the reader aggregates to.
 
-5. **Alerting end-to-end** — a chaos arm (transient dispatch faults
+5. **Out-of-process RPC pass** — two tenants in SEPARATE OS processes
+   (``--rpc-worker`` self-invocations) drive one shared daemon over the
+   PR-20 wire protocol: a *clean* worker with no faults and a *noisy*
+   worker whose client-side plane corrupts/fails/delays ``rpc.send`` /
+   ``rpc.recv`` frames. Both workers' outputs must be bit-identical
+   (sha256 of rows+totals) to solo in-process controls, the noisy
+   worker's books must balance (hard injections == its client retries
+   + recoveries), the clean worker must see ZERO injections and ZERO
+   retries (wire chaos is per-process — the blast radius of a client's
+   transport faults is that client), and the daemon must end with no
+   leases or sessions left behind (2 grants + 2 clean closes journaled).
+
+6. **Alerting end-to-end** — a chaos arm (transient dispatch faults
    with fat retry backoff + a starved host spill tier) must make the
    live :class:`AlertEvaluator` fire and journal ``spill_storm`` and
    ``straggler_spread`` alerts, visible over the wire at the probe's
@@ -379,6 +391,182 @@ def run_two_tenant_leg(args, common: dict, tmp: str) -> dict:
         "clean_degraded": clean_degraded,
         "noisy_sites_hit": noisy_sites,
         "probe": probe_leg,
+    }
+
+
+def outputs_digest(out) -> str:
+    """sha256 over the host bytes of a (rows, totals) pair.
+
+    Canonicalized dtypes (rows uint32, totals int64) so an in-process
+    control (device arrays) and an RPC worker (JSON nested lists)
+    digest identically iff they are bit-identical."""
+    import hashlib
+
+    import numpy as np
+
+    rows, totals = out
+    d = hashlib.sha256()
+    d.update(np.ascontiguousarray(
+        np.asarray(rows, dtype=np.uint32)).tobytes())
+    d.update(np.ascontiguousarray(
+        np.asarray(totals, dtype=np.int64)).tobytes())
+    return d.hexdigest()
+
+
+def rpc_worker_main(args) -> int:
+    """Entry for ``--rpc-worker`` subprocesses: one tenant's RPC driver.
+
+    Runs the same seeded repartition as :func:`run_service_tenant_leg`,
+    but against a daemon in ANOTHER process over the wire protocol,
+    with this process's own fault plane installed (``--fault-spec``) so
+    wire chaos and its books are strictly per-process. Prints one
+    ``RPCSOAK {json}`` line: the output digest plus this side of the
+    ledger (hard injections, client retries, books verdict).
+    """
+    import numpy as np
+
+    from sparkrdma_tpu import faults
+    from sparkrdma_tpu.service.client import RpcClient
+
+    plane = faults.FaultPlane(args.rpc_fault_spec, seed=args.seed)
+    if args.rpc_fault_spec:
+        faults.set_active_plane(plane)
+    c = RpcClient(port=args.rpc_port,
+                  client_id=f"soak-{args.rpc_tenant}",
+                  retry_ms=5.0, deadline_s=120.0)
+    c.hello()
+    c.start_heartbeat()
+    s = c.open_session(args.rpc_tenant)
+    info = c.register_shuffle(s, args.rpc_shuffle_id)  # 0 -> daemon mesh
+    mesh = info["num_parts"]
+    rng = np.random.default_rng(args.seed)
+    x = rng.integers(0, 2**32,
+                     size=(mesh * args.records_per_device,
+                           args.rpc_record_words),
+                     dtype=np.uint32)
+    c.write(s, args.rpc_shuffle_id, x)
+    rows, totals = c.read(s, args.rpc_shuffle_id)
+    c.unregister_shuffle(s, args.rpc_shuffle_id)
+    c.close_session(s)
+    c.close()
+
+    hard = plane.injected_total(("fail", "corrupt"))
+    books = hard == (c.stats["retries"] + faults.recovery_total()
+                     + faults.degradation_total())
+    print("RPCSOAK " + json.dumps({
+        "tenant": args.rpc_tenant,
+        "digest": outputs_digest((rows, totals)),
+        "rows": int(np.asarray(totals).sum()),
+        "hard_injections": hard,
+        "retries": c.stats["retries"],
+        "sites_hit": plane.sites_hit(),
+        "books_balanced": books,
+    }), flush=True)
+    return 0 if books else 1
+
+
+def run_rpc_leg(args, common: dict, tmp: str) -> dict:
+    """The out-of-process pass: two tenant worker PROCESSES, one daemon.
+
+    The daemon (this process) serves the wire protocol; a clean and a
+    noisy worker subprocess each run the seeded repartition through it.
+    The noisy worker's plane corrupts/fails/delays its own ``rpc.send``
+    / ``rpc.recv`` — transient, so its retry loop must converge to the
+    control output. Verdict fields:
+
+    - ``identical``: each worker's output digest == its solo in-process
+      control's digest, bitwise
+    - ``clean`` / ``noisy``: each worker's self-reported ledger — the
+      clean one must show zero injections and zero retries (per-process
+      blast radius), the noisy one balanced books with both wire sites
+      hit
+    - ``sessions_after`` / ``lease_events``: the daemon must be left
+      empty, with both leases granted and cleanly closed in the journal
+    """
+    import subprocess
+
+    from sparkrdma_tpu import ShuffleConf
+    from sparkrdma_tpu.obs.journal import read_entries
+    from sparkrdma_tpu.service import ShuffleService
+
+    rpd = max(args.records_per_device // 8, 64)
+    noisy_spec = ("rpc.send:corrupt@attempt<2;rpc.recv:fail@attempt<2;"
+                  "rpc.send:delay=2ms@0.2")
+    tenants = (("clean", 21, args.seed + 50, ""),
+               ("noisy", 22, args.seed + 60, noisy_spec))
+
+    # --- solo in-process controls (same conf geometry, same seeds) -----
+    conf_ctl = ShuffleConf(spill_dir=os.path.join(tmp, "rpc_ctl"),
+                           **common)
+    control_digest = {}
+    with ShuffleService(conf=conf_ctl) as svc:
+        for tenant, sid, seed, _spec in tenants:
+            out, _ = run_service_tenant_leg(svc, tenant, None, seed,
+                                            rpd, shuffle_id=sid)
+            control_digest[tenant] = outputs_digest(out)
+
+    # --- the daemon + two worker processes over the wire ---------------
+    journal = os.path.join(tmp, "rpc_journal.jsonl")
+    conf_svc = ShuffleConf(spill_dir=os.path.join(tmp, "rpc_duo"),
+                           metrics_sink=journal, rpc_port=0, **common)
+    workers: dict = {}
+    errors: list = []
+    with ShuffleService(conf=conf_svc) as svc:
+        procs = {}
+        for tenant, sid, seed, spec in tenants:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--rpc-worker",
+                   "--rpc-port", str(svc.rpc.port),
+                   "--rpc-tenant", tenant,
+                   "--rpc-shuffle-id", str(sid),
+                   "--rpc-record-words", str(svc.conf.record_words),
+                   "--rpc-fault-spec", spec,
+                   "--seed", str(seed),
+                   "--records-per-device", str(rpd)]
+            procs[tenant] = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+        for tenant, p in procs.items():
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            line = next((ln for ln in out.splitlines()
+                         if ln.startswith("RPCSOAK ")), None)
+            if p.returncode != 0 or line is None:
+                errors.append(f"{tenant}: rc={p.returncode} "
+                              f"out={out[-2000:]}")
+            else:
+                workers[tenant] = json.loads(line[len("RPCSOAK "):])
+        sessions_after = svc.stats()["sessions"]
+        admission_after = svc.stats()["admission"]["active"]
+    lease_events = [e["event"] for e in read_entries(journal)
+                    if e.get("kind") == "lease"]
+
+    clean = workers.get("clean", {})
+    noisy = workers.get("noisy", {})
+    identical = {t: workers.get(t, {}).get("digest") == control_digest[t]
+                 for t in control_digest}
+    ok = (not errors and all(identical.values())
+          and clean.get("hard_injections") == 0
+          and clean.get("retries") == 0
+          and clean.get("books_balanced") is True
+          and noisy.get("hard_injections", 0) >= 4
+          and set(noisy.get("sites_hit", ())) >= {"rpc.send", "rpc.recv"}
+          and noisy.get("books_balanced") is True
+          and sessions_after == 0 and admission_after == 0
+          and lease_events.count("grant") == 2
+          and lease_events.count("close") == 2)
+    return {
+        "ok": ok,
+        "errors": errors,
+        "identical": identical,
+        "clean": clean,
+        "noisy": noisy,
+        "sessions_after": sessions_after,
+        "admission_after": admission_after,
+        "lease_events": lease_events,
     }
 
 
@@ -722,7 +910,26 @@ def main(argv=None) -> int:
     ap.add_argument("--host-devices", type=int, default=8,
                     help="simulated CPU device count when no XLA_FLAGS "
                          "override is present (0 = leave env alone)")
+    # --rpc-worker self-invocation flags (the out-of-process RPC pass
+    # re-runs this script as a pure wire-protocol client; see
+    # rpc_worker_main). Not for interactive use.
+    ap.add_argument("--rpc-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--rpc-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--rpc-tenant", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--rpc-shuffle-id", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--rpc-record-words", type=int, default=9,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--rpc-fault-spec", default="",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.rpc_worker:
+        # pure RPC client: no mesh, no XLA device forcing — the daemon
+        # process owns the data plane
+        return rpc_worker_main(args)
 
     if args.host_devices and "xla_force_host_platform_device_count" \
             not in os.environ.get("XLA_FLAGS", ""):
@@ -803,6 +1010,12 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         combine_leg = run_combine_leg(args, common, tmp)
 
+        # --- out-of-process RPC pass (fresh accounting) ----------------
+        faults.reset_accounting()
+        print("rpc pass: two worker processes over the wire protocol...",
+              file=sys.stderr, flush=True)
+        rpc_leg = run_rpc_leg(args, common, tmp)
+
         # --- alerting pass (fresh accounting) --------------------------
         faults.reset_accounting()
         print("alert pass: chaos fires spill+straggler, control stays "
@@ -820,7 +1033,7 @@ def main(argv=None) -> int:
     sites = plane.sites_hit()
     ok = (all(identical.values()) and len(sites) >= 6 and books
           and not spans_missing_backoff and tenant_leg["ok"]
-          and combine_leg["ok"] and alert_leg["ok"]
+          and combine_leg["ok"] and rpc_leg["ok"] and alert_leg["ok"]
           and planner_leg["ok"])
 
     print(json.dumps({
@@ -839,6 +1052,7 @@ def main(argv=None) -> int:
         "bit_identical": identical,
         "tenant_leg": tenant_leg,
         "combine_leg": combine_leg,
+        "rpc_leg": rpc_leg,
         "alert_leg": alert_leg,
         "planner_leg": planner_leg,
     }, default=str))

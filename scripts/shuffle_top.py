@@ -40,12 +40,22 @@ instead of — or in addition to — journal files: the probe's
 ``/journal`` route returns the same entries the files hold, so the
 rendered tables are identical either way.
 
+``--rpc host:port`` additionally queries a live daemon's **RPC front
+door** (``ShuffleConf.rpc_port``; see ``sparkrdma_tpu/service/rpc.py``)
+for its lease table — one row per connected client with session count,
+lease age, remaining TTL and live/stale verdict. The ``leases`` op
+needs no lease of its own, so the monitor never shows up in the table
+it renders. The frame format (u32 length + u32 CRC-32 + JSON,
+big-endian) is mirrored inline from ``sparkrdma_tpu/service/wire.py``
+to keep this script stdlib-only.
+
 Usage::
 
     python scripts/shuffle_top.py journal.jsonl            # refresh loop
     python scripts/shuffle_top.py 'j_*.jsonl' --once       # one snapshot
     python scripts/shuffle_top.py j.jsonl --interval 5 --stale 30 --wall
     python scripts/shuffle_top.py --connect 127.0.0.1:7077 --once
+    python scripts/shuffle_top.py --rpc 127.0.0.1:7177 --once
 """
 
 from __future__ import annotations
@@ -55,8 +65,10 @@ import glob
 import json
 import os
 import socket
+import struct
 import sys
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 
@@ -181,6 +193,106 @@ def fetch_probe_entries(addr: str, retries: int = 2,
     if status is not None:
         status[addr] = False
     return []
+
+
+# --- RPC front-door lease table (stdlib mirror of service/wire.py) ----
+
+#: must match ``sparkrdma_tpu.service.wire.RPC_SCHEMA_VERSION`` — a
+#: mismatched daemon rejects the request cleanly (non-retryable error)
+#: rather than serving rows this script would misread
+RPC_SCHEMA_VERSION = 1
+
+#: frame header: payload length + CRC-32 of the payload, big-endian
+_RPC_HEADER = struct.Struct(">II")
+
+#: refuse replies larger than this before allocating (a corrupted
+#: length prefix must not look like a 4 GiB read) — wire.MAX_FRAME_BYTES
+_RPC_MAX_FRAME = 64 << 20
+
+
+def _recv_exact(sock_: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock_.recv(n - len(buf))
+        if not chunk:
+            raise OSError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def fetch_lease_rows(addr: str, retries: int = 2,
+                     backoff_s: float = 0.25,
+                     status: Optional[Dict[str, bool]] = None
+                     ) -> List[dict]:
+    """Lease table of a live daemon via the RPC front door's ``leases``
+    op (``host:port``; bare port implies localhost).
+
+    One request per poll over a fresh connection; rows are the same
+    ``wire.LEASE_FIELDS`` dicts the daemon journals on grant/expire.
+    Unreachable or mismatched daemons yield no rows rather than killing
+    the monitor (the ``--connect`` contract); ``status[addr]`` records
+    whether any attempt succeeded.
+    """
+    host, _, port = addr.rpartition(":")
+    host = host or "127.0.0.1"
+    req = {"op": "leases", "req_id": "shuffle-top-leases",
+           "client": "shuffle_top", "schema": RPC_SCHEMA_VERSION,
+           "args": {}}
+    payload = json.dumps(req, separators=(",", ":")).encode("utf-8")
+    frame = _RPC_HEADER.pack(len(payload),
+                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
+    for attempt in range(max(0, retries) + 1):
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=5.0) as c:
+                c.sendall(frame)
+                length, crc = _RPC_HEADER.unpack(
+                    _recv_exact(c, _RPC_HEADER.size))
+                if length > _RPC_MAX_FRAME:
+                    raise ValueError(f"frame length {length} exceeds cap")
+                body = _recv_exact(c, length)
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    raise ValueError("frame CRC mismatch")
+                reply = json.loads(body.decode("utf-8"))
+        except (OSError, ValueError):
+            if attempt < retries:
+                time.sleep(backoff_s * (2 ** attempt))
+            continue
+        if status is not None:
+            status[addr] = True
+        if not isinstance(reply, dict) or not reply.get("ok"):
+            return []
+        value = reply.get("value")
+        return [r for r in value if isinstance(r, dict)] \
+            if isinstance(value, list) else []
+    if status is not None:
+        status[addr] = False
+    return []
+
+
+def render_leases(rows_by_addr: Dict[str, List[dict]]) -> str:
+    """The LEASES panel: one table per ``--rpc`` endpoint, one row per
+    client lease the daemon currently holds."""
+    lines: List[str] = []
+    for addr in sorted(rows_by_addr):
+        rows = rows_by_addr[addr]
+        lines.append("")
+        lines.append(f"leases @ {addr} — {len(rows)} client(s)")
+        lines.append(f"{'CLIENT':<20} {'TENANT':<12} {'SESS':>4} "
+                     f"{'AGE':>7} {'TTL':>7} {'LIVE':<5}  DETAIL")
+        for ls in sorted(rows, key=lambda r: str(r.get("client", ""))):
+            tenant = str(ls.get("tenant", "") or "") or "-"
+            lines.append(
+                f"{str(ls.get('client', '') or '?')[:20]:<20} "
+                f"{tenant[:12]:<12} "
+                f"{int(ls.get('sessions', 0) or 0):>4} "
+                f"{_fmt_age(float(ls.get('age_s', 0.0) or 0.0)):>7} "
+                f"{_fmt_age(float(ls.get('ttl_s', 0.0) or 0.0)):>7} "
+                f"{str(ls.get('event', '') or '?')[:5]:<5}  "
+                f"{str(ls.get('detail', '') or '')}")
+        if not rows:
+            lines.append("  (no live leases)")
+    return "\n".join(lines)
 
 
 def span_latency_ms(s: dict) -> float:
@@ -629,6 +741,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="poll a live daemon's probe endpoint "
                          "(ShuffleConf.probe_port) instead of / besides "
                          "journal files; repeatable for multiple hosts")
+    ap.add_argument("--rpc", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="also render the lease table of a live daemon's "
+                         "RPC front door (ShuffleConf.rpc_port); "
+                         "repeatable for multiple daemons")
     ap.add_argument("--once", action="store_true",
                     help="render one snapshot and exit (no refresh loop)")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -642,8 +759,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="judge heartbeat staleness against the real wall "
                          "clock instead of the journal's newest timestamp")
     args = ap.parse_args(argv)
-    if not args.journals and not args.connect:
-        ap.error("give at least one journal file or --connect HOST:PORT")
+    if not args.journals and not args.connect and not args.rpc:
+        ap.error("give at least one journal file, --connect HOST:PORT "
+                 "or --rpc HOST:PORT")
 
     probe_status: Dict[str, bool] = {}
 
@@ -652,7 +770,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         kinds = collect(_expand(args.journals), connect=args.connect,
                         probe_status=probe_status)
         now = time.time() if args.wall else journal_now(kinds)
-        return render(kinds, now, args.stale, args.rate_window)
+        frame = render(kinds, now, args.stale, args.rate_window)
+        if args.rpc:
+            frame += "\n" + render_leases(
+                {addr: fetch_lease_rows(addr, status=probe_status)
+                 for addr in args.rpc})
+        return frame
 
     def stale_banner() -> str:
         down = sorted(a for a, ok in probe_status.items() if not ok)

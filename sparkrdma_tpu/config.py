@@ -440,6 +440,27 @@ class ShuffleConf:
     #: tenant still over quota (or unadmitted) after this long fails
     #: its operation with a clear error instead of waiting forever.
     admission_wait_s: float = 300.0
+    #: external-service control port (service/rpc.py RpcServer): the
+    #: TCP port on which the daemon serves the length-prefixed-JSON
+    #: RPC protocol to out-of-process ``RpcClient``s. -1 (default)
+    #: disables — the service stays in-process only; 0 binds an
+    #: ephemeral port (tests — read it back from ``rpc.port``).
+    rpc_port: int = -1
+    #: per-client lease duration in seconds: a client whose last
+    #: request/heartbeat is older than this is reaped exactly like a
+    #: clean ``close_session`` (tickets returned, charges released,
+    #: shuffles dropped) with a journaled ``{"kind": "lease"}`` line.
+    #: Clients heartbeat at a third of this. 0 = leases never expire.
+    lease_s: float = 30.0
+    #: RPC client retry backoff base in milliseconds: transport
+    #: failures (drops, CRC-mangled frames, timeouts) retry under
+    #: exponential backoff with deterministic jitter
+    #: (``faults.backoff_ms``). 0 disables the sleep (tight retry).
+    rpc_retry_ms: float = 25.0
+    #: wall-clock deadline across ALL attempts of one RPC call; a
+    #: daemon still unreachable after this long fails the call with
+    #: one clean error instead of retrying forever. 0 = no deadline.
+    rpc_deadline_s: float = 30.0
 
     # --- byte-payload serde (api/serde.py, api/pipeline.py) ---
     #: dispatch encode/decode to the multi-threaded C++ codec in
@@ -553,6 +574,18 @@ class ShuffleConf:
         if self.alert_resolve_windows < 1:
             raise ValueError("alert_resolve_windows must be >= 1 "
                              "(1 = resolve on first clean window)")
+        if not -1 <= self.rpc_port <= 65535:
+            raise ValueError("rpc_port must be in [-1, 65535] "
+                             "(-1 disables, 0 = ephemeral)")
+        if self.lease_s < 0:
+            raise ValueError("lease_s must be >= 0 (0 = leases never "
+                             "expire)")
+        if self.rpc_retry_ms < 0:
+            raise ValueError("rpc_retry_ms must be >= 0 (0 = tight "
+                             "retry, no backoff sleep)")
+        if self.rpc_deadline_s < 0:
+            raise ValueError("rpc_deadline_s must be >= 0 "
+                             "(0 = no deadline)")
         if self.spill_tier_host_bytes < 0:
             raise ValueError("spill_tier_host_bytes must be >= 0 (0 = "
                              "evict every unpinned host segment)")

@@ -17,6 +17,8 @@ site                        where it fires
 ``serde.encode``            api/serde.encode_bytes_rows, native branch
 ``serde.decode``            api/serde.decode_bytes_rows, native branch
 ``checkpoint.read``         MapOutputStore shard/records/meta reads
+``rpc.send``                service/wire.send_frame, before the write
+``rpc.recv``                service/wire.recv_frame, after read, pre-CRC
 ==========================  =================================================
 
 Schedules are parsed from ``ShuffleConf.fault_spec``, a ``;``-joined list
@@ -76,13 +78,16 @@ SITES: Tuple[str, ...] = (
     "serde.encode",
     "serde.decode",
     "checkpoint.read",
+    "rpc.send",
+    "rpc.recv",
 )
 
 #: Sites whose payload a ``corrupt`` action can mangle (the data-carrying
-#: storage sites, where the CRC trailer is the detection contract).
+#: storage and wire sites, where a CRC is the detection contract).
 #: ``checkpoint.read`` is NOT here: checkpoint shards are read through
 #: the ``spill.read`` site (corrupt them there, or on disk directly).
-CORRUPTIBLE: Tuple[str, ...] = ("spill.write", "spill.read")
+CORRUPTIBLE: Tuple[str, ...] = ("spill.write", "spill.read",
+                                "rpc.send", "rpc.recv")
 
 _ACTIONS = ("fail", "corrupt", "delay")
 _DELAY_RE = re.compile(r"^delay=(\d+(?:\.\d+)?)ms$")

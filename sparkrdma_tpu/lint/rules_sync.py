@@ -827,3 +827,195 @@ def check_plan_schema_sync(ctx: LintContext) -> List[Finding]:
                         "plan.executor.PLAN_FIELDS — rename the field "
                         "or fix the script", obj="scripts"))
     return findings
+
+
+# ---------------------------------------------------------------------
+# rpc-schema-sync
+# ---------------------------------------------------------------------
+
+#: lease-row access pattern; by convention the CLIs bind a lease-table
+#: row (or a ``{"kind": "lease"}`` journal line) to ``ls`` before
+#: reading fields from it (the span/rb/hb/al/jb/pl convention)
+LEASE_GET = re.compile(r'\bls\.get\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+
+#: client-side op call sites: every RpcClient convenience method funnels
+#: through ``self._call("<op>", ...)``
+RPC_CALL = re.compile(r'\b_call\(\s*"([a-z_]+)"')
+
+#: the frozensets service/wire.py must declare (the protocol's single
+#: source of truth)
+_WIRE_SETS = ("REQUEST_FIELDS", "REPLY_FIELDS", "OPS", "LEASE_FIELDS")
+
+
+def _marked_dict_keys(sf: SourceFile, marker: str,
+                      value: Optional[str] = None) -> Optional[tuple]:
+    """(keys, lineno) of the first all-literal dict whose string keys
+    include ``marker`` (and, when given, map it to ``value``)."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = []
+        hit = False
+        literal = True
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                literal = False
+                break
+            keys.append(k.value)
+            if k.value == marker and (
+                    value is None
+                    or (isinstance(v, ast.Constant)
+                        and v.value == value)):
+                hit = True
+        if literal and hit:
+            return set(keys), node.lineno
+    return None
+
+
+def _pin_dict(findings: List[Finding], rel: str, keys: Set[str],
+              lineno: int, declared: Set[str], what: str,
+              set_name: str) -> None:
+    """Both-direction key pin of one emitter dict against its set."""
+    for extra in sorted(keys - declared):
+        findings.append(Finding(
+            "rpc-schema-sync", rel, lineno,
+            f"the {what} carries key {extra!r} missing from "
+            f"wire.{set_name} — declare it", obj="sparkrdma_tpu"))
+    for missing in sorted(declared - keys):
+        findings.append(Finding(
+            "rpc-schema-sync", rel, lineno,
+            f"wire.{set_name} declares {missing!r} but the {what} "
+            "never carries it — stale schema entry",
+            obj="sparkrdma_tpu"))
+
+
+@rule("rpc-schema-sync",
+      "client request / server reply / lease-line field sets match "
+      "service/wire.py both directions, the op vocabulary is pinned "
+      "three-way, and CLI lease-field reads exist on the schema",
+      kind="schema-sync")
+def check_rpc_schema_sync(ctx: LintContext) -> List[Finding]:
+    """Convention the rule pins: ``service/wire.py`` owns the protocol
+    as four literal frozensets; ``service/client.py`` builds its
+    request envelope as ONE literal dict (the one carrying an ``"op"``
+    key) and funnels every op through ``_call("<op>")``;
+    ``service/rpc.py`` builds its reply envelope as the literal dict
+    carrying an ``"ok"`` key, its lease line as the ``{"kind":
+    "lease"}`` literal, and routes ops through the handler-table
+    literal containing the ``"hello"`` key. CLIs bind lease rows to
+    ``ls``. The runtime drift checks only fire when a line is emitted;
+    this rule catches every drift at lint time."""
+    wire_sf = ctx.file("sparkrdma_tpu/service/wire.py")
+    if wire_sf is None:
+        return []
+    findings: List[Finding] = []
+    sets: Dict[str, Optional[Set[str]]] = {}
+    for name in _WIRE_SETS:
+        sets[name] = _frozen_field_set(wire_sf, name)
+        if sets[name] is None:
+            findings.append(Finding(
+                "rpc-schema-sync", wire_sf.rel, 0,
+                f"service/wire.py must declare {name} as a literal "
+                "frozenset of strings", obj="sparkrdma_tpu"))
+    if any(v is None for v in sets.values()):
+        return findings
+
+    # (a) the client's request envelope == REQUEST_FIELDS, and its
+    # _call("<op>") sites cover OPS exactly (both directions)
+    client_sf = ctx.file("sparkrdma_tpu/service/client.py")
+    if client_sf is not None:
+        req = _marked_dict_keys(client_sf, "op")
+        if req is None:
+            findings.append(Finding(
+                "rpc-schema-sync", client_sf.rel, 0,
+                "service/client.py builds no literal request dict "
+                "(an all-literal dict carrying an \"op\" key) — the "
+                "envelope drifted from the lintable shape",
+                obj="sparkrdma_tpu"))
+        else:
+            _pin_dict(findings, client_sf.rel, req[0], req[1],
+                      sets["REQUEST_FIELDS"], "request envelope",
+                      "REQUEST_FIELDS")
+        called: Dict[str, int] = {}
+        for lineno, line in enumerate(client_sf.lines, 1):
+            for m in RPC_CALL.finditer(line):
+                called.setdefault(m.group(1), lineno)
+        for op, lineno in sorted(called.items()):
+            if op not in sets["OPS"]:
+                findings.append(Finding(
+                    "rpc-schema-sync", client_sf.rel, lineno,
+                    f"client calls op {op!r} which is not in wire.OPS "
+                    "— typo, or an op that was removed",
+                    obj="sparkrdma_tpu"))
+        for op in sorted(sets["OPS"] - set(called)):
+            findings.append(Finding(
+                "rpc-schema-sync", client_sf.rel, 0,
+                f"wire.OPS declares {op!r} but service/client.py has "
+                "no _call(\"" + op + "\") site — dead op or missing "
+                "client method", obj="sparkrdma_tpu"))
+
+    # (b) the server's reply envelope == REPLY_FIELDS, its lease line
+    # == LEASE_FIELDS, and the handler table's keys == OPS
+    rpc_sf = ctx.file("sparkrdma_tpu/service/rpc.py")
+    if rpc_sf is not None:
+        rep = _marked_dict_keys(rpc_sf, "ok")
+        if rep is None:
+            findings.append(Finding(
+                "rpc-schema-sync", rpc_sf.rel, 0,
+                "service/rpc.py builds no literal reply dict (an "
+                "all-literal dict carrying an \"ok\" key) — the "
+                "envelope drifted from the lintable shape",
+                obj="sparkrdma_tpu"))
+        else:
+            _pin_dict(findings, rpc_sf.rel, rep[0], rep[1],
+                      sets["REPLY_FIELDS"], "reply envelope",
+                      "REPLY_FIELDS")
+        lease = _marked_dict_keys(rpc_sf, "kind", "lease")
+        if lease is None:
+            findings.append(Finding(
+                "rpc-schema-sync", rpc_sf.rel, 0,
+                "service/rpc.py builds no literal {\"kind\": "
+                "\"lease\"} line dict — the emitter drifted from the "
+                "lintable shape", obj="sparkrdma_tpu"))
+        else:
+            _pin_dict(findings, rpc_sf.rel, lease[0], lease[1],
+                      sets["LEASE_FIELDS"], "lease line",
+                      "LEASE_FIELDS")
+        table = _marked_dict_keys(rpc_sf, "hello")
+        if table is None:
+            findings.append(Finding(
+                "rpc-schema-sync", rpc_sf.rel, 0,
+                "service/rpc.py has no literal handler table (a dict "
+                "literal keyed by op names, incl. \"hello\") — "
+                "dispatch drifted from the lintable shape",
+                obj="sparkrdma_tpu"))
+        else:
+            keys, lineno = table
+            for extra in sorted(keys - sets["OPS"]):
+                findings.append(Finding(
+                    "rpc-schema-sync", rpc_sf.rel, lineno,
+                    f"the server handles op {extra!r} which is not in "
+                    "wire.OPS — declare it", obj="sparkrdma_tpu"))
+            for missing in sorted(sets["OPS"] - keys):
+                findings.append(Finding(
+                    "rpc-schema-sync", rpc_sf.rel, lineno,
+                    f"wire.OPS declares {missing!r} but the server "
+                    "handler table has no entry for it — unhandled op",
+                    obj="sparkrdma_tpu"))
+
+    # (c) every CLI read of a lease field exists on the schema
+    for script in SPAN_READERS:
+        sf = ctx.file(f"scripts/{script}")
+        if sf is None:
+            continue
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in LEASE_GET.finditer(line):
+                if m.group(1) not in sets["LEASE_FIELDS"]:
+                    findings.append(Finding(
+                        "rpc-schema-sync", sf.rel, lineno,
+                        f"scripts/{script} reads lease field "
+                        f"{m.group(1)!r} which does not exist in "
+                        "wire.LEASE_FIELDS — rename the field or fix "
+                        "the script", obj="scripts"))
+    return findings

@@ -147,7 +147,16 @@ log = logging.getLogger("sparkrdma_tpu.journal")
 #: a v12 reader skips the unknown kind, a v13 reader reads v12 lines
 #: verbatim (pinned both directions by tests/test_trace.py and
 #: tests/test_obs.py).
-SCHEMA_VERSION = 13
+#: v14: + auxiliary ``{"kind": "lease"}`` lines (service/rpc.py
+#: LEASE_FIELDS — one line per RPC-lease lifecycle event: grant on
+#: ``hello``, expire when a client misses its heartbeats and the
+#: server reaps the session like a clean close, close on ``goodbye``,
+#: adopt when a relaunched daemon re-adopts checkpointed exchange
+#: output via ``resume_segments`` — consumed by ``shuffle_top``'s
+#: lease table). Span fields are unchanged from v13, so v13↔v14
+#: interchange is pure kind-tolerance like v12↔v13 (pinned both
+#: directions by tests/test_service_rpc.py).
+SCHEMA_VERSION = 14
 
 
 @dataclasses.dataclass

@@ -78,6 +78,14 @@ COUNTERS = frozenset({
     "service.admission_waits",
     "service.sessions_opened",
     "service.sessions_closed",
+    "service.rpc.requests",
+    "service.rpc.errors",
+    "service.rpc.replays",
+    "service.rpc.calls",
+    "service.rpc.retries",
+    "service.leases_granted",
+    "service.leases_renewed",
+    "service.leases_expired",
     "tsdb.samples",
     "tsdb.evictions",
     "probe.requests",

@@ -9,12 +9,22 @@ handle. Per-tenant quotas span all three storage tiers
 admission controller (:mod:`~sparkrdma_tpu.service.admission`) keeps
 one tenant's oversubscribed terasort from starving another's small
 join.
+
+Out-of-process callers reach the same session surface over the wire:
+:class:`~sparkrdma_tpu.service.rpc.RpcServer` (auto-started when
+``conf.rpc_port >= 0``) serves the length-prefixed-JSON protocol of
+:mod:`~sparkrdma_tpu.service.wire` under per-client leases, and
+:class:`~sparkrdma_tpu.service.client.RpcClient` is the retrying,
+idempotent client half.
 """
 
 from sparkrdma_tpu.service.admission import AdmissionController
+from sparkrdma_tpu.service.client import RpcCallError, RpcClient
 from sparkrdma_tpu.service.daemon import ShuffleService
+from sparkrdma_tpu.service.rpc import RpcError, RpcServer
 from sparkrdma_tpu.service.tenant import (QuotaExceededError, TenantAccount,
                                           TenantQuota, TenantRegistry)
 
 __all__ = ["ShuffleService", "AdmissionController", "TenantAccount",
-           "TenantQuota", "TenantRegistry", "QuotaExceededError"]
+           "TenantQuota", "TenantRegistry", "QuotaExceededError",
+           "RpcServer", "RpcClient", "RpcError", "RpcCallError"]

@@ -48,6 +48,7 @@ from sparkrdma_tpu.obs.rollup import HeartbeatEmitter
 from sparkrdma_tpu.obs.tsdb import NULL_TELEMETRY, TelemetryStore
 from sparkrdma_tpu.runtime.mesh import MeshRuntime
 from sparkrdma_tpu.service.admission import AdmissionController
+from sparkrdma_tpu.service.rpc import RpcServer
 from sparkrdma_tpu.service.tenant import (TenantAccount, TenantQuota,
                                           TenantRegistry)
 
@@ -146,6 +147,19 @@ class ShuffleService:
                 resolve_after=self.conf.alert_resolve_windows,
                 geometry=f"w{self.runtime.num_partitions}")
             self.alerts.start()
+        # the network front door: out-of-process clients reach the
+        # session surface over the wire protocol (service/rpc.py)
+        # under per-client leases. Like the probe, a bind failure must
+        # never take the daemon down — the in-process surface and the
+        # data plane are intact without it.
+        self.rpc = None
+        if self.conf.rpc_port >= 0:
+            try:
+                self.rpc = RpcServer(self, port=self.conf.rpc_port)
+                self.rpc.start()
+            except OSError:
+                log.warning("rpc endpoint failed to bind port %d",
+                            self.conf.rpc_port, exc_info=True)
         self.probe = None
         if self.conf.probe_port >= 0:
             try:
@@ -291,6 +305,9 @@ class ShuffleService:
         if self.alerts is not None:
             self.alerts.stop()          # persists dirty baselines
             self.alerts = None
+        if self.rpc is not None:
+            self.rpc.stop()
+            self.rpc = None
         if self.probe is not None:
             self.probe.stop()
             self.probe = None

@@ -1,0 +1,50 @@
+"""External shuffle daemon process for the kill-and-restart test.
+
+Run as::
+
+    python tests/rpc_daemon.py <port> <spill_dir> <sink> <lease_s>
+
+with ``JAX_PLATFORMS=cpu``. Starts a :class:`ShuffleService` with the
+RPC front door on the FIXED ``port`` (the relaunch must reuse it so the
+client's retry loop reconnects without re-resolution), the checkpoint
+store rooted at ``spill_dir`` (rolling restart adopts segments from
+there) and the journal appended to ``sink`` (the path sink opens in
+append mode, so both daemon incarnations write ONE continuous journal
+— that is what lets the test count exchange spans across the kill).
+
+Prints ``RPCREADY port=P pid=N`` once serving, then parks until killed
+— SIGKILL is the test's whole point, so there is no graceful teardown
+path here.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    spill_dir = sys.argv[2]
+    sink = sys.argv[3]
+    lease_s = float(sys.argv[4])
+
+    # the same 8-device CPU mesh the test harness forces (conftest.py),
+    # so the daemon's exchange geometry matches the in-process control
+    from _hostmesh import force_cpu_devices
+    assert force_cpu_devices(8), "forced 8-device CPU mesh unavailable"
+
+    from sparkrdma_tpu.config import ShuffleConf
+    from sparkrdma_tpu.service import ShuffleService
+
+    conf = ShuffleConf(rpc_port=port, lease_s=lease_s,
+                       spill_dir=spill_dir, metrics_sink=sink)
+    svc = ShuffleService(conf=conf)
+    assert svc.rpc is not None, "rpc endpoint failed to bind"
+    assert svc.rpc.port == port
+    print(f"RPCREADY port={svc.rpc.port} pid={os.getpid()}", flush=True)
+    while True:
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

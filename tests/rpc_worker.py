@@ -1,0 +1,55 @@
+"""RPC client process for the client-SIGKILL lease-reap test.
+
+Run as::
+
+    python tests/rpc_worker.py <port> <tenant> <shuffle_id> <rpd> <seed>
+
+with ``JAX_PLATFORMS=cpu``. Connects to a daemon on ``port``, admits
+itself under ``tenant``, takes an admission ticket, runs one
+write+read (leaving the shuffle registered so the tenant's store
+charges stay held), prints a ``RPCHELD`` sentinel and then parks
+holding the lease (heartbeating) until the test SIGKILLs it — the
+server must then reap everything the sentinel line says it held.
+"""
+
+import sys
+import time
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    tenant = sys.argv[2]
+    shuffle_id = int(sys.argv[3])
+    rpd = int(sys.argv[4])
+    seed = int(sys.argv[5])
+
+    import numpy as np
+
+    from sparkrdma_tpu.service.client import RpcClient
+
+    c = RpcClient(port=port, client_id=f"victim-{tenant}",
+                  retry_ms=5.0, deadline_s=30.0)
+    c.hello()
+    c.start_heartbeat()          # lease_s / 3 cadence
+    session = c.open_session(tenant)
+    ticket = c.admit(tenant, 1)
+    info = c.register_shuffle(session, shuffle_id)
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 2**32, size=(info["num_parts"] * rpd, 4),
+                     dtype=np.uint32)
+    c.write(session, shuffle_id, x)
+    rows, totals = c.read(session, shuffle_id, checkpoint=True)
+    # adopt the checkpoint so the tenant HOLDS disk-tier store charges
+    adopted = c.resume_read(session, shuffle_id)["adopted"]
+    assert adopted, "expected the checkpoint to be adopted"
+    # deliberately NO unregister/close: the held ticket, session and
+    # store segments are exactly what the lease reap must release
+    print(f"RPCHELD client={c.client_id} session={session} "
+          f"ticket={ticket} rows={int(np.asarray(totals).sum())}",
+          flush=True)
+    while True:
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

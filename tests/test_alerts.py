@@ -164,7 +164,7 @@ class TestAlertLine:
         assert journal.lines == [line]
 
     def test_schema_is_v13(self):
-        assert SCHEMA_VERSION == 13
+        assert SCHEMA_VERSION == 14
 
     def test_v10_reader_interchange(self):
         """An alert line is a new KIND, not new span fields: a v10-era
@@ -188,7 +188,7 @@ class TestAlertLine:
                             record_bytes=16, plan_s=0.0, exchange_s=0.1,
                             sort_s=0.0, per_peer_records=[10])
         d = span.to_dict()
-        assert d["schema"] == 13
+        assert d["schema"] == 14
         assert ExchangeSpan.from_dict(d) == span
 
     def test_active_lines_are_valid_alert_lines(self):

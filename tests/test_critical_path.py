@@ -248,8 +248,8 @@ class TestSchemaV10:
         return ExchangeSpan(**base)
 
     def test_schema_version_is_thirteen(self):
-        assert SCHEMA_VERSION == 13
-        assert self._make().schema == 13
+        assert SCHEMA_VERSION == 14
+        assert self._make().schema == 14
 
     def test_v9_line_parses_under_v10_reader(self):
         """A pre-attribution journal line: the new fields default to
@@ -306,7 +306,7 @@ class TestE2EAttribution:
         finally:
             manager.stop()
         (span,) = read_journal(str(sink))
-        assert span.schema == 13
+        assert span.schema == 14
         assert span.bottleneck in cp.VERDICTS
         wall = span.plan_s + span.exchange_s + span.sort_s
         assert wall > 0

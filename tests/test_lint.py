@@ -477,6 +477,124 @@ def test_plan_schema_sync_skips_without_executor_module(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# rpc schema sync
+# ---------------------------------------------------------------------
+
+_WIRE = """
+    RPC_SCHEMA_VERSION = 1
+    REQUEST_FIELDS = frozenset({"op", "req_id", "client", "schema",
+                                "args"})
+    REPLY_FIELDS = frozenset({"ok", "req_id", "schema", "value",
+                              "error", "retryable"})
+    OPS = frozenset({"hello", "read"})
+    LEASE_FIELDS = frozenset({"kind", "schema", "ts", "event",
+                              "client", "ttl_s"})
+"""
+
+_RPC_CLIENT = """
+    class RpcClient:
+        def _call(self, op, **args):
+            return {
+                "op": op,
+                "req_id": "r1",
+                "client": "c1",
+                "schema": 1,
+                "args": args,
+            }
+
+        def hello(self):
+            return self._call("hello")
+
+        def read(self):
+            return self._call("read")
+"""
+
+_RPC_SERVER = """
+    _HANDLERS = {"hello": "_op_hello", "read": "_op_read"}
+
+    def lease_line(event, client):
+        return {"kind": "lease", "schema": 14, "ts": 0.0,
+                "event": event, "client": client, "ttl_s": 0.0}
+
+    def reply(req_id, ok, value):
+        return {"ok": ok, "req_id": req_id, "schema": 1,
+                "value": value, "error": "", "retryable": False}
+"""
+
+
+def test_rpc_schema_sync_clean(tmp_path):
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/service/wire.py": _WIRE,
+        "sparkrdma_tpu/service/client.py": _RPC_CLIENT,
+        "sparkrdma_tpu/service/rpc.py": _RPC_SERVER,
+        "scripts/shuffle_top.py": """
+            def row(ls):
+                return (ls.get("client"), ls.get("ttl_s"))
+        """,
+    })
+    assert run_rules(root, select=["rpc-schema-sync"]) == []
+
+
+def test_rpc_schema_sync_request_field_drift_both_ways(tmp_path):
+    # the envelope carries a key REQUEST_FIELDS misses AND the schema
+    # declares a key the envelope never carries — both directions fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/service/wire.py": _WIRE,
+        "sparkrdma_tpu/service/client.py": _RPC_CLIENT.replace(
+            '"args": args,', '"params": args,'),
+    })
+    got = run_rules(root, select=["rpc-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert rules_of(got) == ["rpc-schema-sync", "rpc-schema-sync"]
+    assert "'params'" in msgs and "'args'" in msgs
+
+
+def test_rpc_schema_sync_op_vocabulary_three_way(tmp_path):
+    # the client calls an op the wire never declared, and the server's
+    # handler table misses a declared op — both sides fire
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/service/wire.py": _WIRE,
+        "sparkrdma_tpu/service/client.py": _RPC_CLIENT.replace(
+            'self._call("read")', 'self._call("raed")'),
+        "sparkrdma_tpu/service/rpc.py": _RPC_SERVER.replace(
+            ', "read": "_op_read"', ''),
+    })
+    got = run_rules(root, select=["rpc-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert "'raed'" in msgs                  # undeclared client op
+    assert "no _call" in msgs                # 'read' has no site left
+    assert "no entry" in msgs                # unhandled server op
+
+
+def test_rpc_schema_sync_lease_line_and_cli_reads(tmp_path):
+    # the lease emitter drops a declared key; the CLI reads a ghost
+    root = repo(tmp_path, {
+        "sparkrdma_tpu/service/wire.py": _WIRE,
+        "sparkrdma_tpu/service/rpc.py": _RPC_SERVER.replace(
+            '"ttl_s": 0.0}', '"expires_s": 0.0}'),
+        "scripts/shuffle_top.py": """
+            def row(ls):
+                return ls.get("liveness_flag")
+        """,
+    })
+    got = run_rules(root, select=["rpc-schema-sync"])
+    msgs = " | ".join(f.message for f in got)
+    assert "'expires_s'" in msgs and "'ttl_s'" in msgs
+    assert "liveness_flag" in msgs
+    assert any(f.obj == "scripts" for f in got)
+
+
+def test_rpc_schema_sync_skips_without_wire_module(tmp_path):
+    root = repo(tmp_path, {
+        "scripts/shuffle_top.py": """
+            def row(ls):
+                return ls.get("anything_goes")
+        """,
+    })
+    assert run_rules(root, select=["rpc-schema-sync"]) == []
+
+
+# ---------------------------------------------------------------------
 # timeline pairing
 # ---------------------------------------------------------------------
 
@@ -1605,7 +1723,7 @@ def test_real_repo_is_srlint_clean():
     every rule, zero findings (modulo in-source suppressions) — and the
     full run must fit the tier-1 preamble's wall-clock budget."""
     from sparkrdma_tpu.lint import all_rules
-    assert len(all_rules()) == 22, \
+    assert len(all_rules()) == 23, \
         "rule count drifted — update this pin, the README table, and " \
         "COVERAGE.md together"
     t0 = time.perf_counter()

@@ -153,7 +153,7 @@ class TestJournal:
                          retry_count=1, pool_high_water=4, spill_count=2)
         d = span.to_dict()
         assert d["total_bytes"] == span.records * span.record_bytes
-        assert d["schema"] == 13
+        assert d["schema"] == 14
         back = ExchangeSpan.from_dict(d)
         assert back == span
 
@@ -221,8 +221,8 @@ V1_FIELDS = ("span_id", "shuffle_id", "transport", "rounds", "dispatches",
 
 class TestSchemaVersioning:
     def test_schema_version_is_thirteen(self):
-        assert SCHEMA_VERSION == 13
-        assert make_span().schema == 13
+        assert SCHEMA_VERSION == 14
+        assert make_span().schema == 14
 
     def test_v1_line_parses_under_v2_reader(self):
         """A journal written before the timeline existed still reads:
@@ -611,7 +611,7 @@ class TestManagerJournalE2E:
         manager, plan = self._run_shuffle(conf, rng)
         (span,) = read_journal(str(sink))
         assert span.shuffle_id == 90
-        assert span.schema == 13
+        assert span.schema == 14
         assert span.transport == conf.transport
         assert span.rounds == plan.num_rounds
         assert span.records == plan.total_records

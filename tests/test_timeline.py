@@ -291,7 +291,7 @@ class TestStreamingTimelineE2E:
         sink = tmp_path / "stream.jsonl"
         manager = _run_streaming_read(_streaming_conf(str(sink)), rng)
         (span,) = read_journal(str(sink))
-        assert span.schema == 13
+        assert span.schema == 14
         assert span.rounds > 1, "must actually be the streaming regime"
         names = [e["name"] for e in span.events]
         assert "stream:prep" in names

@@ -285,8 +285,8 @@ class TestSchemaV12:
         return ExchangeSpan(**base)
 
     def test_schema_version_is_thirteen(self):
-        assert SCHEMA_VERSION == 13
-        assert self._make().schema == 13
+        assert SCHEMA_VERSION == 14
+        assert self._make().schema == 14
 
     def test_v11_line_parses_under_v12_reader(self):
         """A pre-tracing journal line: the trace fields default to
@@ -457,7 +457,7 @@ class TestGoldenCLIs:
         assert kinds == ["admission", "alert", "alert", "heartbeat",
                          "job", "rollup", "span", "span"]
         (jb,) = [e for e in entries if e.get("kind") == "job"]
-        assert jb["schema"] in (12, 13) and jb["stage_count"] == 2
+        assert jb["schema"] in (12, 13, 14) and jb["stage_count"] == 2
         for e in entries:
             if e.get("kind") in ("span", "rollup", "heartbeat",
                                  "admission", "job"):
